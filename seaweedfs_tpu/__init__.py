@@ -1,0 +1,19 @@
+"""seaweedfs_tpu — a TPU-native distributed blob/file store.
+
+A from-scratch rebuild of the capabilities of SeaweedFS (a Haystack-style
+object store with an f4-style erasure-coded warm tier), designed TPU-first:
+
+- the hot compute path (Reed-Solomon RS(10,4) erasure coding over GF(2^8))
+  runs as bit-matrix matmuls on TPU via JAX/XLA (`seaweedfs_tpu.ec`),
+  sharded over device meshes with `jax.sharding` for multi-chip scale;
+- the storage engine (needles, volumes, needle maps) is a deterministic,
+  format-compatible reimplementation (`seaweedfs_tpu.storage`);
+- the cluster plane (master/topology/heartbeat), filer, and gateways follow
+  the reference's architecture but in Python asyncio + gRPC/HTTP, with C++
+  native kernels where the host must be fast without a TPU.
+
+On-disk formats are byte-compatible with the reference implementation
+(see docstring citations of the form ``weed/...go:line``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+"""Storage engine: needle format, volumes, needle maps, superblock.
+
+Byte-compatible with the reference's `weed/storage` layer.
+"""

@@ -1,0 +1,94 @@
+"""CRC-32C (Castagnoli) needle checksums, with the reference's masked value.
+
+The reference checksums needle data with CRC-32C (`weed/storage/needle/crc.go`,
+klauspost/crc32 Castagnoli table) and stores a *masked* value on disk:
+
+    Value() = rotr32(crc, 15) + 0xa282ead8        (crc.go:24-26)
+
+(the snappy/leveldb CRC mask). Both the raw crc and the masked value are
+exposed here. A C++ kernel (slicing-by-8) is used when the native library is
+available; otherwise a Python table implementation is used.
+"""
+
+from __future__ import annotations
+
+CASTAGNOLI_POLY_REFLECTED = 0x82F63B78
+_MASK_DELTA = 0xA282EAD8
+
+# 8 tables for slicing-by-8 (table[0] is the classic byte-at-a-time table).
+_TABLES: list[list[int]] = []
+
+
+def _build_tables() -> None:
+    t0 = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (CASTAGNOLI_POLY_REFLECTED if crc & 1 else 0)
+        t0.append(crc)
+    _TABLES.append(t0)
+    for k in range(1, 8):
+        prev = _TABLES[k - 1]
+        _TABLES.append([(prev[i] >> 8) ^ t0[prev[i] & 0xFF] for i in range(256)])
+
+
+_build_tables()
+
+_native_update = None
+
+
+def _try_load_native() -> None:
+    global _native_update
+    try:
+        from seaweedfs_tpu.native import lib as _nl
+
+        _native_update = _nl.crc32c_update
+    except Exception:
+        _native_update = None
+
+
+_try_load_native()
+
+
+def _py_update(crc: int, data: bytes) -> int:
+    crc ^= 0xFFFFFFFF
+    t = _TABLES
+    n = len(data)
+    i = 0
+    # slicing-by-8
+    mv = memoryview(data)
+    while n - i >= 8:
+        crc ^= int.from_bytes(mv[i : i + 4], "little")
+        crc = (
+            t[7][crc & 0xFF]
+            ^ t[6][(crc >> 8) & 0xFF]
+            ^ t[5][(crc >> 16) & 0xFF]
+            ^ t[4][(crc >> 24) & 0xFF]
+            ^ t[3][mv[i + 4]]
+            ^ t[2][mv[i + 5]]
+            ^ t[1][mv[i + 6]]
+            ^ t[0][mv[i + 7]]
+        )
+        i += 8
+    while i < n:
+        crc = (crc >> 8) ^ t[0][(crc ^ mv[i]) & 0xFF]
+        i += 1
+    return crc ^ 0xFFFFFFFF
+
+
+def update(crc: int, data: bytes) -> int:
+    """Incremental CRC-32C, matching Go's ``crc32.Update`` semantics."""
+    if _native_update is not None:
+        return _native_update(crc, data)
+    return _py_update(crc, data)
+
+
+def new(data: bytes = b"") -> int:
+    """CRC-32C of ``data`` from a zero seed (crc.go:16-18)."""
+    return update(0, data)
+
+
+def masked_value(crc: int) -> int:
+    """The value actually stored on disk (crc.go:24-26)."""
+    rot = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+    return (rot + _MASK_DELTA) & 0xFFFFFFFF

@@ -1,0 +1,78 @@
+"""Index (.idx / .ecx) entries: 16 bytes each (with 4-byte offsets).
+
+Layout per entry (`weed/storage/idx/walk.go:49-55`):
+    key u64BE | offset (4 or 5 bytes, scaled /8) | size u32BE (signed)
+
+``walk_index_file`` streams entries in file order (append order for .idx,
+ascending-key order for .ecx).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import BinaryIO, Callable, Iterator
+
+from .types import (
+    NEEDLE_ID_SIZE,
+    OFFSET_SIZE,
+    SIZE_SIZE,
+    bytes_to_needle_id,
+    bytes_to_offset,
+    bytes_to_size,
+    needle_id_to_bytes,
+    needle_map_entry_size,
+    offset_to_bytes,
+    size_to_bytes,
+)
+
+ROWS_TO_READ = 1024
+
+
+def pack_entry(key: int, actual_offset: int, size: int, offset_size: int = OFFSET_SIZE) -> bytes:
+    """One index entry; ``actual_offset`` is the real byte offset (stored /8)."""
+    return (
+        needle_id_to_bytes(key)
+        + offset_to_bytes(actual_offset, offset_size)
+        + size_to_bytes(size)
+    )
+
+
+def unpack_entry(b: bytes, offset_size: int = OFFSET_SIZE) -> tuple[int, int, int]:
+    """(key, actual_offset, size) from one entry."""
+    key = bytes_to_needle_id(b[:NEEDLE_ID_SIZE])
+    off = bytes_to_offset(b[NEEDLE_ID_SIZE : NEEDLE_ID_SIZE + offset_size], offset_size)
+    size = bytes_to_size(
+        b[NEEDLE_ID_SIZE + offset_size : NEEDLE_ID_SIZE + offset_size + SIZE_SIZE]
+    )
+    return key, off, size
+
+
+def iter_index_file(
+    r: BinaryIO, offset_size: int = OFFSET_SIZE
+) -> Iterator[tuple[int, int, int]]:
+    """Yield (key, actual_offset, size) for every entry in an index stream."""
+    entry_size = needle_map_entry_size(offset_size)
+    r.seek(0)
+    while True:
+        chunk = r.read(entry_size * ROWS_TO_READ)
+        if not chunk:
+            return
+        for i in range(0, len(chunk) - entry_size + 1, entry_size):
+            yield unpack_entry(chunk[i : i + entry_size], offset_size)
+        if len(chunk) % entry_size:
+            return  # torn tail entry — ignore, matching reference tolerance
+
+
+def walk_index_file(
+    r: BinaryIO,
+    fn: Callable[[int, int, int], None],
+    offset_size: int = OFFSET_SIZE,
+) -> None:
+    for key, off, size in iter_index_file(r, offset_size):
+        fn(key, off, size)
+
+
+def iter_index_bytes(
+    b: bytes, offset_size: int = OFFSET_SIZE
+) -> Iterator[tuple[int, int, int]]:
+    yield from iter_index_file(io.BytesIO(b), offset_size)

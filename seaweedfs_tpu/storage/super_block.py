@@ -1,0 +1,59 @@
+"""Volume superblock: the first 8 bytes of every .dat file.
+
+Layout (`weed/storage/super_block/super_block.go:16-23`):
+    byte 0:    needle version (1/2/3)
+    byte 1:    replica placement byte (xyz)
+    bytes 2-3: TTL (count, unit)
+    bytes 4-5: compaction revision u16BE
+    bytes 6-7: extra-size u16BE (0 unless pb extra present), extra follows
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from .needle import CURRENT_VERSION
+from .replica_placement import ReplicaPlacement
+from .ttl import TTL, EMPTY_TTL, load_ttl_from_bytes
+
+SUPER_BLOCK_SIZE = 8
+
+
+@dataclass
+class SuperBlock:
+    version: int = CURRENT_VERSION
+    replica_placement: ReplicaPlacement = field(default_factory=ReplicaPlacement)
+    ttl: TTL = field(default_factory=lambda: EMPTY_TTL)
+    compaction_revision: int = 0
+    extra: bytes = b""  # serialized SuperBlockExtra pb, rarely used
+
+    def block_size(self) -> int:
+        return SUPER_BLOCK_SIZE + (len(self.extra) if self.extra else 0)
+
+    def to_bytes(self) -> bytes:
+        header = bytearray(SUPER_BLOCK_SIZE)
+        header[0] = self.version
+        header[1] = self.replica_placement.to_byte()
+        header[2:4] = self.ttl.to_bytes()
+        struct.pack_into(">H", header, 4, self.compaction_revision)
+        if self.extra:
+            if len(self.extra) > 256 * 256 - 2:
+                raise ValueError("super block extra too large")
+            struct.pack_into(">H", header, 6, len(self.extra))
+            return bytes(header) + self.extra
+        return bytes(header)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "SuperBlock":
+        if len(b) < SUPER_BLOCK_SIZE:
+            raise ValueError("super block truncated")
+        version = b[0]
+        if version not in (1, 2, 3):
+            raise ValueError(f"unsupported volume version {version}")
+        rp = ReplicaPlacement.from_byte(b[1])
+        ttl = load_ttl_from_bytes(b[2:4])
+        rev = struct.unpack(">H", b[4:6])[0]
+        extra_size = struct.unpack(">H", b[6:8])[0]
+        extra = bytes(b[8 : 8 + extra_size]) if extra_size else b""
+        return cls(version, rp, ttl, rev, extra)

@@ -1,0 +1,83 @@
+"""Volume/needle TTLs: 2-byte (count, unit) encoding.
+
+Matches `weed/storage/needle/volume_ttl.go`: units are minute/hour/day/week/
+month/year stored as 1..6; human strings like "3m", "4h", "5d", "6w", "7M",
+"8y" (bare digits mean minutes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+EMPTY = 0
+MINUTE = 1
+HOUR = 2
+DAY = 3
+WEEK = 4
+MONTH = 5
+YEAR = 6
+
+_UNIT_FROM_CHAR = {"m": MINUTE, "h": HOUR, "d": DAY, "w": WEEK, "M": MONTH, "y": YEAR}
+_CHAR_FROM_UNIT = {v: k for k, v in _UNIT_FROM_CHAR.items()}
+_MINUTES = {
+    EMPTY: 0,
+    MINUTE: 1,
+    HOUR: 60,
+    DAY: 60 * 24,
+    WEEK: 60 * 24 * 7,
+    MONTH: 60 * 24 * 31,
+    YEAR: 60 * 24 * 365,
+}
+
+
+@dataclass(frozen=True)
+class TTL:
+    count: int = 0
+    unit: int = EMPTY
+
+    def to_bytes(self) -> bytes:
+        return bytes([self.count & 0xFF, self.unit & 0xFF])
+
+    def to_uint32(self) -> int:
+        if self.count == 0:
+            return 0
+        return ((self.count & 0xFF) << 8) | (self.unit & 0xFF)
+
+    def minutes(self) -> int:
+        return self.count * _MINUTES.get(self.unit, 0)
+
+    def __str__(self) -> str:
+        if self.count == 0 or self.unit == EMPTY:
+            return ""
+        return f"{self.count}{_CHAR_FROM_UNIT.get(self.unit, '')}"
+
+    def __bool__(self) -> bool:
+        return self.count != 0 and self.unit != EMPTY
+
+
+EMPTY_TTL = TTL()
+
+
+def read_ttl(s: str) -> TTL:
+    """Parse a human TTL string (volume_ttl.go:35-49)."""
+    if not s:
+        return EMPTY_TTL
+    unit_char = s[-1]
+    if unit_char.isdigit():
+        count_str, unit = s, MINUTE
+    else:
+        count_str, unit = s[:-1], _UNIT_FROM_CHAR.get(unit_char, EMPTY)
+    count = int(count_str)
+    if not 0 <= count <= 255:
+        raise ValueError(f"ttl count {count} out of byte range")
+    return TTL(count, unit)
+
+
+def load_ttl_from_bytes(b: bytes) -> TTL:
+    if b[0] == 0 and b[1] == 0:
+        return EMPTY_TTL
+    return TTL(b[0], b[1])
+
+
+def load_ttl_from_uint32(v: int) -> TTL:
+    return load_ttl_from_bytes(bytes([(v >> 8) & 0xFF, v & 0xFF]))

@@ -1,0 +1,137 @@
+"""Core on-disk scalar types: needle ids, cookies, sizes, aligned offsets.
+
+Byte layouts match the reference (`weed/storage/types/needle_types.go:33-40`,
+`offset_4bytes.go`, `offset_5bytes.go`, `needle_id_type.go`):
+
+- NeedleId: uint64, big-endian on disk (8 bytes)
+- Cookie:   uint32, big-endian (4 bytes)
+- Size:     int32 stored as uint32 big-endian; negative values (and the
+  special TOMBSTONE -1) mark deletions
+- Offset:   byte offset / 8 (NeedlePaddingSize alignment), stored as 4 bytes
+  big-endian (default build, 32 GB max volume) or 5 bytes with the
+  "5BytesOffset" flavor (the 5th byte is the *most* significant and is
+  appended after the low 4 — matching `offset_5bytes.go:17-25`)
+"""
+
+from __future__ import annotations
+
+import struct
+
+# -- constants (weed/storage/types/needle_types.go:33-40) --------------------
+COOKIE_SIZE = 4
+NEEDLE_ID_SIZE = 8
+SIZE_SIZE = 4
+NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
+TIMESTAMP_SIZE = 8
+NEEDLE_PADDING_SIZE = 8
+NEEDLE_CHECKSUM_SIZE = 4
+TOMBSTONE_FILE_SIZE = -1
+
+# Default build flavor: 4-byte offsets, 32GB max volume
+# (weed/storage/types/offset_4bytes.go:13-15). The 5-byte flavor
+# (offset_5bytes.go) raises the cap to 8 EB; both are supported here via the
+# ``offset_size`` parameter.
+OFFSET_SIZE_4 = 4
+OFFSET_SIZE_5 = 5
+OFFSET_SIZE = OFFSET_SIZE_4
+MAX_POSSIBLE_VOLUME_SIZE_4 = 4 * 1024 * 1024 * 1024 * 8  # 32 GB
+MAX_POSSIBLE_VOLUME_SIZE_5 = MAX_POSSIBLE_VOLUME_SIZE_4 * 256
+
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+
+
+def needle_map_entry_size(offset_size: int = OFFSET_SIZE) -> int:
+    return NEEDLE_ID_SIZE + offset_size + SIZE_SIZE
+
+
+def max_possible_volume_size(offset_size: int = OFFSET_SIZE) -> int:
+    return (
+        MAX_POSSIBLE_VOLUME_SIZE_5
+        if offset_size == OFFSET_SIZE_5
+        else MAX_POSSIBLE_VOLUME_SIZE_4
+    )
+
+
+# -- size helpers (weed/storage/types/needle_types.go:17-23) -----------------
+def size_is_deleted(size: int) -> bool:
+    return size < 0 or size == TOMBSTONE_FILE_SIZE
+
+
+def size_is_valid(size: int) -> bool:
+    return size > 0 and size != TOMBSTONE_FILE_SIZE
+
+
+def size_to_bytes(size: int) -> bytes:
+    """int32 size → 4 bytes big-endian (two's complement for tombstones)."""
+    return struct.pack(">I", size & 0xFFFFFFFF)
+
+
+def bytes_to_size(b: bytes) -> int:
+    """4 bytes big-endian → signed int32."""
+    return struct.unpack(">i", b[:4])[0]
+
+
+# -- needle id / cookie ------------------------------------------------------
+def needle_id_to_bytes(needle_id: int) -> bytes:
+    return struct.pack(">Q", needle_id)
+
+
+def bytes_to_needle_id(b: bytes) -> int:
+    return struct.unpack(">Q", b[:8])[0]
+
+
+def cookie_to_bytes(cookie: int) -> bytes:
+    return struct.pack(">I", cookie)
+
+
+def bytes_to_cookie(b: bytes) -> int:
+    return struct.unpack(">I", b[:4])[0]
+
+
+def parse_needle_id(s: str) -> int:
+    """Hex string → needle id (weed/storage/types/needle_id_type.go:40-46)."""
+    v = int(s, 16)
+    if v < 0 or v > 0xFFFFFFFFFFFFFFFF:
+        raise ValueError(f"needle id {s} out of range")
+    return v
+
+
+def parse_cookie(s: str) -> int:
+    """Hex string → cookie (weed/storage/types/needle_types.go:55-61)."""
+    v = int(s, 16)
+    if v < 0 or v > 0xFFFFFFFF:
+        raise ValueError(f"cookie {s} out of range")
+    return v
+
+
+# -- offsets -----------------------------------------------------------------
+# Offsets are stored divided by NEEDLE_PADDING_SIZE (all needle records are
+# 8-byte aligned). The 4-byte encoding is plain big-endian uint32 of the
+# scaled value; the 5-byte encoding appends the most-significant 5th byte
+# AFTER the big-endian low 4 (weed/storage/types/offset_5bytes.go:17-25).
+
+def offset_to_bytes(actual_offset: int, offset_size: int = OFFSET_SIZE) -> bytes:
+    if actual_offset % NEEDLE_PADDING_SIZE != 0:
+        raise ValueError(f"offset {actual_offset} not {NEEDLE_PADDING_SIZE}-aligned")
+    scaled = actual_offset // NEEDLE_PADDING_SIZE
+    if offset_size == OFFSET_SIZE_4:
+        if scaled > 0xFFFFFFFF:
+            raise ValueError(f"offset {actual_offset} exceeds 32GB volume cap")
+        return struct.pack(">I", scaled)
+    low = struct.pack(">I", scaled & 0xFFFFFFFF)
+    b4 = (scaled >> 32) & 0xFF
+    if scaled >> 40:
+        raise ValueError(f"offset {actual_offset} exceeds 5-byte offset cap")
+    return low + bytes([b4])
+
+
+def bytes_to_offset(b: bytes, offset_size: int = OFFSET_SIZE) -> int:
+    """Stored offset bytes → actual byte offset (already ×8)."""
+    scaled = struct.unpack(">I", b[:4])[0]
+    if offset_size == OFFSET_SIZE_5:
+        scaled |= b[4] << 32
+    return scaled * NEEDLE_PADDING_SIZE
+
+
+def offset_is_zero(b: bytes) -> bool:
+    return all(x == 0 for x in b)

@@ -1,0 +1,142 @@
+"""Tests for scalar types, file ids, TTL, replica placement, superblock, idx."""
+
+import io
+
+import pytest
+
+from seaweedfs_tpu.storage import idx, types
+from seaweedfs_tpu.storage.file_id import (
+    FileId,
+    format_needle_id_cookie,
+    parse_needle_id_cookie,
+    parse_path,
+)
+from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.storage.super_block import SuperBlock
+from seaweedfs_tpu.storage.ttl import EMPTY_TTL, TTL, load_ttl_from_uint32, read_ttl
+
+
+# -- offsets -----------------------------------------------------------------
+def test_offset_roundtrip_4byte():
+    for off in (0, 8, 4096, 2**35 - 8):
+        b = types.offset_to_bytes(off, 4)
+        assert len(b) == 4
+        assert types.bytes_to_offset(b, 4) == off
+
+
+def test_offset_roundtrip_5byte():
+    off = 2**40  # beyond 32GB cap
+    b = types.offset_to_bytes(off, 5)
+    assert len(b) == 5
+    assert types.bytes_to_offset(b, 5) == off
+
+
+def test_offset_rejects_unaligned_and_overflow():
+    with pytest.raises(ValueError):
+        types.offset_to_bytes(7, 4)
+    with pytest.raises(ValueError):
+        types.offset_to_bytes(types.MAX_POSSIBLE_VOLUME_SIZE_4 * 2, 4)
+
+
+def test_size_tombstone():
+    b = types.size_to_bytes(types.TOMBSTONE_FILE_SIZE)
+    assert b == b"\xff\xff\xff\xff"
+    assert types.bytes_to_size(b) == -1
+    assert types.size_is_deleted(-1)
+    assert not types.size_is_valid(-1)
+    assert types.size_is_valid(10)
+
+
+# -- file ids ----------------------------------------------------------------
+def test_fid_format_strips_leading_zero_bytes():
+    # example fid from the reference README: 3,01637037d6
+    s = format_needle_id_cookie(0x01, 0x637037D6)
+    assert s == "01637037d6"
+    fid = FileId(3, 0x01, 0x637037D6)
+    assert str(fid) == "3,01637037d6"
+    assert FileId.parse("3,01637037d6") == fid
+
+
+def test_fid_roundtrip_large_key():
+    fid = FileId(123, 0xFFEEDDCCBBAA9988, 0x01020304)
+    assert FileId.parse(str(fid)) == fid
+
+
+def test_parse_needle_id_cookie_bounds():
+    with pytest.raises(ValueError):
+        parse_needle_id_cookie("1234567")  # too short (<= 8 chars)
+    with pytest.raises(ValueError):
+        parse_needle_id_cookie("0" * 25)  # too long
+
+
+def test_parse_path_with_delta():
+    nid, cookie = parse_path("01637037d6_2")
+    assert nid == 0x01 + 2
+    assert cookie == 0x637037D6
+
+
+# -- ttl ---------------------------------------------------------------------
+def test_ttl_parse_and_roundtrip():
+    for s, minutes in (("3m", 3), ("4h", 240), ("5d", 7200), ("6w", 60480)):
+        t = read_ttl(s)
+        assert str(t) == s
+        assert t.minutes() == minutes
+        assert load_ttl_from_uint32(t.to_uint32()) == t
+    assert read_ttl("") is EMPTY_TTL
+    assert read_ttl("90") == TTL(90, 1)  # bare digits = minutes
+
+
+# -- replica placement -------------------------------------------------------
+def test_replica_placement():
+    rp = ReplicaPlacement.from_string("012")
+    assert rp.diff_data_center_count == 0
+    assert rp.diff_rack_count == 1
+    assert rp.same_rack_count == 2
+    assert rp.copy_count() == 4
+    assert str(rp) == "012"
+    assert ReplicaPlacement.from_byte(rp.to_byte()) == rp
+    with pytest.raises(ValueError):
+        ReplicaPlacement.from_string("005")
+
+
+# -- superblock --------------------------------------------------------------
+def test_super_block_roundtrip():
+    sb = SuperBlock(
+        version=3,
+        replica_placement=ReplicaPlacement.from_string("001"),
+        ttl=read_ttl("1d"),
+        compaction_revision=7,
+    )
+    b = sb.to_bytes()
+    assert len(b) == 8
+    assert b[0] == 3
+    assert b[1] == 1
+    sb2 = SuperBlock.from_bytes(b)
+    assert sb2 == sb
+
+
+def test_super_block_rejects_bad_version():
+    with pytest.raises(ValueError):
+        SuperBlock.from_bytes(b"\x09" + b"\x00" * 7)
+
+
+# -- idx ---------------------------------------------------------------------
+def test_idx_entry_roundtrip():
+    e = idx.pack_entry(0x1122334455667788, 8 * 1000, 4321)
+    assert len(e) == 16
+    assert idx.unpack_entry(e) == (0x1122334455667788, 8000, 4321)
+
+
+def test_idx_walk():
+    buf = io.BytesIO()
+    entries = [(i + 1, i * 8, 100 + i) for i in range(3000)]
+    for k, o, s in entries:
+        buf.write(idx.pack_entry(k, o, s))
+    assert list(idx.iter_index_file(buf)) == entries
+
+
+def test_idx_walk_ignores_torn_tail():
+    buf = io.BytesIO()
+    buf.write(idx.pack_entry(1, 0, 10))
+    buf.write(b"\x01\x02\x03")  # torn partial entry
+    assert list(idx.iter_index_file(buf)) == [(1, 0, 10)]

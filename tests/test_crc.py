@@ -1,0 +1,36 @@
+"""CRC-32C vectors + the reference's masked on-disk value (crc.go:24-26)."""
+
+from seaweedfs_tpu.storage import crc
+
+
+def test_crc32c_known_vectors():
+    # standard CRC-32C check value
+    assert crc.new(b"123456789") == 0xE3069283
+    assert crc.new(b"") == 0
+    # RFC 3720 appendix B.4 test vectors
+    assert crc.new(b"\x00" * 32) == 0x8A9136AA
+    assert crc.new(b"\xff" * 32) == 0x62A8AB43
+    assert crc.new(bytes(range(32))) == 0x46DD794E
+
+
+def test_incremental_update_matches_oneshot():
+    data = bytes(range(256)) * 7 + b"tail"
+    c = 0
+    for i in range(0, len(data), 13):
+        c = crc.update(c, data[i : i + 13])
+    assert c == crc.new(data)
+
+
+def test_masked_value():
+    # Value() = rotr32(crc,15) + 0xa282ead8
+    c = crc.new(b"123456789")
+    rot = ((c >> 15) | (c << 17)) & 0xFFFFFFFF
+    assert crc.masked_value(c) == (rot + 0xA282EAD8) & 0xFFFFFFFF
+    assert crc.masked_value(0) == 0xA282EAD8
+
+
+def test_py_path_matches_native_if_present():
+    data = b"the quick brown fox" * 100
+    assert crc._py_update(0, data) == crc.update(0, data) or crc._native_update is None
+    if crc._native_update is not None:
+        assert crc._py_update(0, data) == crc._native_update(0, data)

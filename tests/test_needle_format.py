@@ -1,0 +1,141 @@
+"""Golden-layout tests for the needle on-disk format.
+
+Expected byte vectors are hand-derived from the layout rules in
+weed/storage/needle/needle_read_write.go:33-128 (see needle.py docstring).
+"""
+
+import struct
+
+import pytest
+
+from seaweedfs_tpu.storage import crc as crc32c
+from seaweedfs_tpu.storage.needle import (
+    FLAG_HAS_MIME,
+    FLAG_HAS_NAME,
+    FLAG_HAS_TTL,
+    VERSION1,
+    VERSION2,
+    VERSION3,
+    CrcError,
+    Needle,
+    get_actual_size,
+    needle_body_length,
+    padding_length,
+)
+from seaweedfs_tpu.storage.ttl import read_ttl
+
+
+def test_padding_always_1_to_8():
+    for size in range(0, 64):
+        for v in (VERSION1, VERSION2, VERSION3):
+            p = padding_length(size, v)
+            assert 1 <= p <= 8
+            total = get_actual_size(size, v)
+            assert total % 8 == 0
+
+
+def test_golden_v3_simple_data():
+    # data="abc", no optional fields: size = 4 + 3 + 1 = 8
+    n = Needle(cookie=0x11223344, id=0x0102030405060708, data=b"abc", append_at_ns=42)
+    blob = n.to_bytes(VERSION3)
+    assert n.size == 8
+    # header
+    assert blob[0:4] == bytes.fromhex("11223344")
+    assert blob[4:12] == bytes.fromhex("0102030405060708")
+    assert blob[12:16] == struct.pack(">I", 8)
+    # body: data_size, data, flags
+    assert blob[16:20] == struct.pack(">I", 3)
+    assert blob[20:23] == b"abc"
+    assert blob[23] == 0
+    # checksum (masked crc32c of data)
+    expect_ck = crc32c.masked_value(crc32c.new(b"abc"))
+    assert blob[24:28] == struct.pack(">I", expect_ck)
+    # append_at_ns
+    assert blob[28:36] == struct.pack(">Q", 42)
+    # padding: used = 16+8+4+8 = 36 → pad 4; v3 pad aliases size bytes
+    assert len(blob) == 40
+    assert blob[36:40] == struct.pack(">I", 8)
+    assert len(blob) == get_actual_size(n.size, VERSION3)
+
+
+def test_golden_v2_padding_aliases_id():
+    n = Needle(cookie=1, id=0xAABBCCDDEEFF0011, data=b"abc")
+    blob = n.to_bytes(VERSION2)
+    # used = 16 + 8 + 4 = 28 → pad 4 → total 32
+    assert len(blob) == 32
+    assert blob[28:32] == bytes.fromhex("aabbccdd")
+
+
+def test_golden_v1():
+    n = Needle(cookie=7, id=9, data=b"hello")
+    blob = n.to_bytes(VERSION1)
+    assert blob[12:16] == struct.pack(">I", 5)
+    assert blob[16:21] == b"hello"
+    # used = 16+5+4 = 25 → pad 7 (aliases id bytes)
+    assert len(blob) == 32
+    assert blob[25:32] == struct.pack(">Q", 9)[:7]
+
+
+def test_roundtrip_all_fields():
+    n = Needle(
+        cookie=0xDEADBEEF,
+        id=12345678901234567,
+        data=b"some file content" * 10,
+        name=b"file.txt",
+        mime=b"text/plain",
+        last_modified=1600000000,
+        ttl=read_ttl("3h"),
+        append_at_ns=1234567890123456789,
+    )
+    n.set_flag(FLAG_HAS_NAME)
+    n.set_flag(FLAG_HAS_MIME)
+    n.set_flag(0x08)  # last modified
+    n.set_flag(FLAG_HAS_TTL)
+    blob = n.to_bytes(VERSION3)
+    assert len(blob) % 8 == 0
+
+    m = Needle.from_bytes(blob, n.size, VERSION3)
+    assert m.cookie == n.cookie
+    assert m.id == n.id
+    assert m.data == n.data
+    assert m.name == n.name
+    assert m.mime == n.mime
+    assert m.last_modified == n.last_modified
+    assert str(m.ttl) == "3h"
+    assert m.append_at_ns == n.append_at_ns
+    assert m.checksum == crc32c.new(n.data)
+
+
+def test_roundtrip_empty_data():
+    n = Needle(cookie=5, id=6)
+    blob = n.to_bytes(VERSION3)
+    assert n.size == 0
+    # header + checksum + ts + padding(4) = 16+4+8+4 = 32
+    assert len(blob) == 32
+    m = Needle.from_bytes(blob, 0, VERSION3)
+    assert m.data == b""
+
+
+def test_crc_detects_corruption():
+    n = Needle(cookie=1, id=2, data=b"payload-bytes")
+    blob = bytearray(n.to_bytes(VERSION3))
+    blob[21] ^= 0xFF  # flip a data byte
+    with pytest.raises(CrcError):
+        Needle.from_bytes(bytes(blob), n.size, VERSION3)
+
+
+def test_body_length_matches():
+    for size in (0, 1, 7, 8, 100, 255):
+        for v in (VERSION2, VERSION3):
+            assert get_actual_size(size, v) == 16 + needle_body_length(size, v)
+
+
+def test_pairs_roundtrip():
+    from seaweedfs_tpu.storage.needle import FLAG_HAS_PAIRS
+
+    n = Needle(cookie=1, id=2, data=b"x", pairs=b'{"k":"v"}')
+    n.set_flag(FLAG_HAS_PAIRS)
+    blob = n.to_bytes(VERSION3)
+    m = Needle.from_bytes(blob, n.size, VERSION3)
+    assert m.pairs == b'{"k":"v"}'
+    assert m.has(FLAG_HAS_PAIRS)
