@@ -1,0 +1,145 @@
+"""Headline benchmark: RS(10,4) ec.encode throughput, GB/s per chip.
+
+Prints ONE JSON line:
+    {"metric": "ec.encode", "value": <GB/s>, "unit": "GB/s/chip",
+     "vs_baseline": <value / 8.0>, ...extras}
+
+Baseline: BASELINE.md north star — ≥8 GB/s/chip RS(10,4) encode on TPU v5e,
+bit-identical to the Go/klauspost path (correctness is asserted against the
+C++ oracle before timing).
+
+Method notes:
+- Volume bytes are generated on-device: this terminal reaches its TPU through
+  a tunnel whose host↔device link is ~100 MB/s (not representative of a real
+  v5e host's PCIe). On-device generation isolates the encode kernel, which is
+  the component this framework replaces (the klauspost SIMD Encode loop,
+  `weed/storage/erasure_coding/ec_encoder.go:179`).
+- Each chunk-size config is probed in a fresh subprocess: the tunneled chip's
+  free HBM varies (shared pool), and a RESOURCE_EXHAUSTED poisons the whole
+  device session, so in-process retries always fail.
+- All diagnostics go to stderr; stdout carries exactly one JSON line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def probe(chunk_mb: int, tile_mb: int, iters: int = 8) -> None:
+    """Child mode: time one config, print a single float (GB/s) to stdout."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ec.codec import TpuCodec
+
+    codec = TpuCodec(
+        chunk_bytes=chunk_mb * 1024 * 1024, tile_bytes=tile_mb * 1024 * 1024
+    )
+    n = chunk_mb * 1024 * 1024
+
+    @jax.jit
+    def checksum(x):
+        return jnp.sum(x, dtype=jnp.uint32)
+
+    data = jax.random.bits(jax.random.PRNGKey(0), (10, n), dtype=jnp.uint8)
+    data.block_until_ready()
+    p = codec.matmul_device(codec.parity_rows, data)
+    _ = int(checksum(p))  # compile + warm
+    t0 = time.perf_counter()
+    acc = None
+    for _ in range(iters):
+        p = codec.matmul_device(codec.parity_rows, data)
+        s = checksum(p)
+        acc = s if acc is None else acc + s
+    _ = int(acc)  # forces execution of the whole chain
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{10 * n / dt / 1e9:.4f}")
+
+
+def main() -> None:
+    import numpy as np
+
+    t_setup = time.perf_counter()
+
+    # -- correctness gate (subprocess-free, small shapes) ---------------------
+    from seaweedfs_tpu.ec.codec import CpuCodec, TpuCodec
+
+    cpu = CpuCodec()
+    tpu_small = TpuCodec(chunk_bytes=8 * 65536, tile_bytes=65536)
+    rng = np.random.default_rng(0)
+    gate = rng.integers(0, 256, (10, 3 * 65536 + 777), dtype=np.uint8)
+    if not np.array_equal(cpu.encode(gate), tpu_small.encode(gate)):
+        print(
+            json.dumps(
+                {
+                    "metric": "ec.encode",
+                    "value": 0.0,
+                    "unit": "GB/s/chip",
+                    "vs_baseline": 0.0,
+                    "error": "bit-identity check FAILED",
+                }
+            )
+        )
+        return
+    log("bit-identity vs C++ oracle: OK")
+
+    import jax
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.device_kind} ({dev.platform})")
+
+    # -- probe configs in fresh subprocesses ----------------------------------
+    best, best_cfg = 0.0, None
+    successes = 0
+    for chunk_mb, tile_mb in ((64, 4), (32, 4), (16, 2), (8, 1), (4, 1)):
+        cmd = [sys.executable, os.path.abspath(__file__), "--probe", str(chunk_mb), str(tile_mb)]
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=420,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                gbps = float(r.stdout.strip().splitlines()[-1])
+                log(f"chunk={chunk_mb}MB tile={tile_mb}MB: {gbps:.2f} GB/s")
+                successes += 1
+                if gbps > best:
+                    best, best_cfg = gbps, (chunk_mb, tile_mb)
+            else:
+                tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+                log(f"chunk={chunk_mb}MB failed: {tail[0][:140]}")
+        except subprocess.TimeoutExpired:
+            log(f"chunk={chunk_mb}MB timed out")
+        if successes >= 2 or best > 4 * 8.0:
+            break  # enough signal; don't burn bench time
+
+    log(f"best: {best:.2f} GB/s at {best_cfg}, total {time.perf_counter() - t_setup:.0f}s")
+    print(
+        json.dumps(
+            {
+                "metric": "ec.encode",
+                "value": round(best, 2),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(best / 8.0, 3),
+                "baseline": "8 GB/s/chip RS(10,4) target (BASELINE.md)",
+                "config": {
+                    "rs": [10, 4],
+                    "chunk_mb": best_cfg[0] if best_cfg else None,
+                    "tile_mb": best_cfg[1] if best_cfg else None,
+                    "device": f"{dev.device_kind}",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--probe":
+        probe(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        main()

@@ -1,0 +1,294 @@
+"""RS codecs: encode/reconstruct with pluggable backends (tpu | cpu | numpy).
+
+All backends compute the same function — GF(2^8) matmul with the
+klauspost-compatible matrix (gf.build_matrix) — so shard bytes are identical
+regardless of where they were computed. Mirrors the reference's use of
+`reedsolomon.Encoder` (Encode/Reconstruct/ReconstructData — call sites
+`weed/storage/erasure_coding/ec_encoder.go:179,270`,
+`weed/storage/store_ec.go:367`).
+
+The TPU backend expresses the GF(2^8) matmul as a GF(2) bit-matrix matmul:
+bytes are unpacked to bits, multiplied by the 8×-expanded bit matrix with an
+int8 MXU matmul, reduced mod 2, and repacked. See gf.gf_matrix_to_bit_matrix.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import gf
+from .constants import DATA_SHARDS, PARITY_SHARDS
+
+
+class Codec:
+    """Base: shard-count bookkeeping + reconstruct planning (host-side)."""
+
+    def __init__(self, data_shards: int = DATA_SHARDS, parity_shards: int = PARITY_SHARDS):
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = gf.build_matrix(data_shards, self.total_shards)
+        self.parity_rows = self.matrix[data_shards:]
+
+    # -- backend hook --------------------------------------------------------
+    def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        """(R×k GF matrix) @ (k×N bytes) → (R×N bytes). Backend-specific."""
+        raise NotImplementedError
+
+    # -- public API ----------------------------------------------------------
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data (k, N) → parity (m, N)."""
+        if data.shape[0] != self.data_shards:
+            raise ValueError(f"expected {self.data_shards} data rows, got {data.shape[0]}")
+        return self.matmul(self.parity_rows, data)
+
+    def encode_shards(self, data: np.ndarray) -> np.ndarray:
+        """data (k, N) → all shards (k+m, N) (data rows pass through)."""
+        return np.concatenate([data, self.encode(data)], axis=0)
+
+    def _decode_matrix_for(self, present: Sequence[int]) -> np.ndarray:
+        """Inverse of the matrix rows for the first k present shards.
+
+        Mirrors klauspost's Reconstruct: collect valid shards in index order
+        until k are found; the decode matrix maps those k shards back to the
+        k data shards.
+        """
+        rows = list(present)[: self.data_shards]
+        if len(rows) < self.data_shards:
+            raise ValueError(
+                f"need {self.data_shards} shards to reconstruct, have {len(rows)}"
+            )
+        sub = self.matrix[rows]
+        return gf.mat_invert(sub)
+
+    def reconstruct(
+        self, shards: list[Optional[np.ndarray]], data_only: bool = False
+    ) -> list[np.ndarray]:
+        """Fill in missing (None) shards in place; returns the full list.
+
+        Bit-identical to klauspost Encoder.Reconstruct / ReconstructData.
+        """
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} shards")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        missing = [i for i, s in enumerate(shards) if s is None]
+        if not missing:
+            return shards  # nothing to do
+        if len(present) < self.data_shards:
+            raise ValueError("too few shards to reconstruct")
+
+        first_k = present[: self.data_shards]
+        sub_data = np.stack([shards[i] for i in first_k])
+        missing_data = [i for i in missing if i < self.data_shards]
+        missing_parity = [i for i in missing if i >= self.data_shards]
+
+        if missing_data:
+            decode = self._decode_matrix_for(first_k)
+            rows = decode[missing_data]  # (|md| × k)
+            rebuilt = self.matmul(rows, sub_data)
+            for j, i in enumerate(missing_data):
+                shards[i] = rebuilt[j]
+
+        if missing_parity and not data_only:
+            all_data = np.stack([shards[i] for i in range(self.data_shards)])
+            rows = self.matrix[missing_parity]
+            rebuilt = self.matmul(rows, all_data)
+            for j, i in enumerate(missing_parity):
+                shards[i] = rebuilt[j]
+
+        return shards
+
+    def reconstruct_data(self, shards: list[Optional[np.ndarray]]) -> list[np.ndarray]:
+        """Rebuild only missing data shards (store_ec.go ReconstructData path)."""
+        return self.reconstruct(shards, data_only=True)
+
+    def verify(self, shards: np.ndarray) -> bool:
+        """Check parity rows match the data rows (klauspost Encoder.Verify)."""
+        expect = self.encode(np.asarray(shards[: self.data_shards]))
+        return bool(np.array_equal(expect, shards[self.data_shards :]))
+
+
+class NumpyCodec(Codec):
+    """Pure-numpy GF matmul via the 256×256 table. Oracle-grade, not fast."""
+
+    def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        mt = gf.get_mul_table()
+        out = np.zeros((matrix.shape[0], data.shape[1]), dtype=np.uint8)
+        for r in range(matrix.shape[0]):
+            for c in range(matrix.shape[1]):
+                coef = matrix[r, c]
+                if coef:
+                    out[r] ^= mt[coef, data[c]]
+        return out
+
+
+class CpuCodec(Codec):
+    """C++ native kernel (seaweedfs_tpu/native)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        from seaweedfs_tpu.native import lib
+
+        self._lib = lib
+
+    def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        return self._lib.rs_matmul(matrix, data)
+
+
+class TpuCodec(Codec):
+    """JAX bit-matmul kernel; runs on TPU (or any jax backend).
+
+    Data is processed in fixed-size column chunks so the jit traces once;
+    the tail chunk is zero-padded to the chunk width (zeros encode to zeros
+    and are sliced off, so output bytes are unaffected).
+    """
+
+    def __init__(
+        self,
+        *args,
+        chunk_bytes: int = 64 * 1024 * 1024,
+        tile_bytes: int = 4 * 1024 * 1024,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        import jax  # deferred so numpy/cpu paths never require jax
+
+        self._jax = jax
+        if chunk_bytes % tile_bytes:
+            raise ValueError("chunk_bytes must be a multiple of tile_bytes")
+        self.chunk_bytes = chunk_bytes
+        self.tile_bytes = tile_bytes
+        self._jit_cache: dict = {}
+        self._bitmat_cache: dict = {}
+
+    def _kernel(self, n_out_rows: int, k: int):
+        """Jitted tiled bit-matmul for a (n_out_rows × k) matrix shape.
+
+        One launch covers a whole chunk (amortizing dispatch latency, which
+        dominates on tunneled single-chip setups), while a fori_loop over
+        column tiles keeps the 8× bit-expansion intermediate at tile size
+        instead of chunk size in HBM.
+        """
+        key = (n_out_rows, k)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            jax = self._jax
+            jnp = jax.numpy
+            lax = jax.lax
+            tile = self.tile_bytes
+
+            def matmul_tile(bitmat, data_tile):
+                kk, n = data_tile.shape
+                shifts = jnp.arange(8, dtype=jnp.uint8)
+                bits = (data_tile[:, None, :] >> shifts[None, :, None]) & jnp.uint8(1)
+                bits = bits.reshape(kk * 8, n).astype(jnp.int8)
+                acc = lax.dot_general(
+                    bitmat,
+                    bits,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )
+                out_bits = (acc & 1).astype(jnp.uint8).reshape(-1, 8, n)
+                weights = (jnp.uint8(1) << shifts)[None, :, None]
+                return jnp.sum(out_bits * weights, axis=1, dtype=jnp.uint32).astype(
+                    jnp.uint8
+                )
+
+            @jax.jit
+            def gf_bit_matmul(bitmat, data):
+                kk, n = data.shape
+                if n <= tile:
+                    return matmul_tile(bitmat, data)
+                n_tiles = n // tile  # callers pad chunks to tile multiples
+
+                def body(i, out):
+                    piece = lax.dynamic_slice(data, (0, i * tile), (kk, tile))
+                    res = matmul_tile(bitmat, piece)
+                    return lax.dynamic_update_slice(out, res, (0, i * tile))
+
+                out = jnp.zeros((bitmat.shape[0] // 8, n), dtype=jnp.uint8)
+                return lax.fori_loop(0, n_tiles, body, out)
+
+            fn = gf_bit_matmul
+            self._jit_cache[key] = fn
+        return fn
+
+    def _bitmat(self, matrix: np.ndarray):
+        """Device-resident bit matrix, cached so repeated calls (e.g. the
+        benchmark's timed loop) don't re-expand or re-upload it."""
+        key = matrix.tobytes()
+        cached = self._bitmat_cache.get(key)
+        if cached is None:
+            cached = self._jax.device_put(
+                gf.gf_matrix_to_bit_matrix(matrix).astype(np.int8)
+            )
+            self._bitmat_cache[key] = cached
+        return cached
+
+    def matmul_device(self, matrix: np.ndarray, data_dev):
+        """Device-resident matmul: data_dev is a jax array (k, N) already in
+        HBM; returns a jax array (R, N). N must be ≤ chunk and tile-aligned
+        (or ≤ one tile). This is the zero-copy path used by the benchmark and
+        the streaming encoder's overlap pipeline."""
+        kernel = self._kernel(*matrix.shape)
+        return kernel(self._bitmat(matrix), data_dev)
+
+    def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        jnp = self._jax.numpy
+        out_rows, k = matrix.shape
+        n = data.shape[1]
+        bitmat = self._bitmat(matrix)
+        kernel = self._kernel(out_rows, k)
+
+        if n <= self.tile_bytes:
+            return np.asarray(kernel(bitmat, jnp.asarray(data)))
+
+        out = np.empty((out_rows, n), dtype=np.uint8)
+        chunk = self.chunk_bytes
+        pos = 0
+        while pos < n:
+            end = min(pos + chunk, n)
+            piece = data[:, pos:end]
+            width = end - pos
+            if width % self.tile_bytes and width > self.tile_bytes:
+                padded = self.tile_bytes * -(-width // self.tile_bytes)
+                piece = np.pad(piece, ((0, 0), (0, padded - width)))
+            res = np.asarray(kernel(bitmat, jnp.asarray(piece)))
+            out[:, pos:end] = res[:, :width]
+            pos = end
+        return out
+
+
+_BACKENDS = {"numpy": NumpyCodec, "cpu": CpuCodec, "tpu": TpuCodec}
+
+
+def get_codec(
+    backend: str | None = None,
+    data_shards: int = DATA_SHARDS,
+    parity_shards: int = PARITY_SHARDS,
+    **kwargs,
+) -> Codec:
+    """Codec factory. Default backend: $SWEED_EC_BACKEND or 'tpu' with jax,
+    falling back to 'cpu'."""
+    if backend is None:
+        backend = os.environ.get("SWEED_EC_BACKEND", "")
+    if not backend:
+        try:
+            import jax  # noqa: F401
+
+            backend = "tpu"
+        except ImportError:
+            backend = "cpu"
+    try:
+        cls = _BACKENDS[backend]
+    except KeyError:
+        raise ValueError(f"unknown ec backend {backend!r} (want tpu|cpu|numpy)")
+    try:
+        return cls(data_shards, parity_shards, **kwargs)
+    except ImportError:
+        if backend != "numpy":
+            return NumpyCodec(data_shards, parity_shards)
+        raise

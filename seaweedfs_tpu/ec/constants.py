@@ -1,0 +1,13 @@
+"""EC geometry constants (weed/storage/erasure_coding/ec_encoder.go:17-23)."""
+
+DATA_SHARDS = 10
+PARITY_SHARDS = 4
+TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
+LARGE_BLOCK_SIZE = 1024 * 1024 * 1024  # 1 GB
+SMALL_BLOCK_SIZE = 1024 * 1024  # 1 MB
+EC_BUFFER_SIZE = 256 * 1024  # reference io buffer; ours batch far larger
+
+
+def shard_ext(shard_id: int) -> str:
+    """Shard file extension .ec00 .. .ec13 (ec_encoder.go:64-66)."""
+    return f".ec{shard_id:02d}"

@@ -1,0 +1,78 @@
+"""Loader for the native C++ kernel library (_sweed_native.so).
+
+Builds lazily with g++ on first import if the shared object is missing or
+older than the source, then exposes ctypes wrappers. All callers must
+tolerate ImportError and fall back to pure-Python/numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "sweed_native.cpp")
+_SO = os.path.join(_DIR, "_sweed_native.so")
+
+
+def _ensure_built() -> str:
+    if (not os.path.exists(_SO)) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        try:
+            subprocess.run(
+                ["make", "-C", _DIR, "-s"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+            out = getattr(e, "stderr", b"") or b""
+            raise ImportError(f"native build failed: {out.decode(errors='replace')}")
+    return _SO
+
+
+class _Lib:
+    def __init__(self) -> None:
+        self._c = ctypes.CDLL(_ensure_built())
+        self._c.sweed_crc32c_update.restype = ctypes.c_uint32
+        self._c.sweed_crc32c_update.argtypes = [
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        self._c.sweed_rs_matmul.restype = None
+        self._c.sweed_rs_matmul.argtypes = [
+            ctypes.c_void_p,  # matrix
+            ctypes.c_int,  # out_rows
+            ctypes.c_int,  # k
+            ctypes.c_size_t,  # n
+            ctypes.c_void_p,  # in
+            ctypes.c_void_p,  # out
+        ]
+
+    def crc32c_update(self, crc: int, data: bytes) -> int:
+        return self._c.sweed_crc32c_update(crc, data, len(data))
+
+    def rs_matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        """(out_rows×k GF matrix) @ (k×n bytes) → (out_rows×n bytes)."""
+        matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        out_rows, k = matrix.shape
+        k2, n = data.shape
+        if k != k2:
+            raise ValueError(f"matrix k={k} != data rows {k2}")
+        out = np.empty((out_rows, n), dtype=np.uint8)
+        self._c.sweed_rs_matmul(
+            matrix.ctypes.data,
+            out_rows,
+            k,
+            n,
+            data.ctypes.data,
+            out.ctypes.data,
+        )
+        return out
+
+
+lib = _Lib()

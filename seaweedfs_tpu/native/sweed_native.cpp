@@ -1,0 +1,142 @@
+// sweed_native: host-side kernels for seaweedfs_tpu.
+//
+// The reference leans on native SIMD in its dependencies (klauspost/reedsolomon
+// amd64 assembly for GF(2^8), hardware CRC32 in the Go stdlib). This library is
+// our host equivalent: a portable C++ Reed-Solomon matmul over GF(2^8) (poly
+// 0x11D, klauspost-compatible) used as the CPU fallback + cross-check oracle
+// for the TPU codec, and CRC-32C (Castagnoli, slicing-by-8) for needle
+// checksums (weed/storage/needle/crc.go).
+//
+// Build: make -C seaweedfs_tpu/native   (g++ -O3 -shared -fPIC)
+// ABI: plain C functions, consumed via ctypes.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+// ---------------- GF(2^8), poly 0x11D ----------------
+constexpr uint32_t kPoly = 0x11D;
+
+struct GfTables {
+  uint8_t exp[512];
+  int32_t log[256];
+  // mul[a][b] lazily derived via log/exp in rs_matmul setup
+  GfTables() {
+    uint32_t x = 1;
+    for (int i = 0; i < 255; i++) {
+      exp[i] = static_cast<uint8_t>(x);
+      log[x] = i;
+      x <<= 1;
+      if (x & 0x100) x ^= kPoly;
+    }
+    for (int i = 255; i < 512; i++) exp[i] = exp[i - 255];
+    log[0] = -1;
+  }
+  uint8_t mul(uint8_t a, uint8_t b) const {
+    if (a == 0 || b == 0) return 0;
+    return exp[log[a] + log[b]];
+  }
+};
+
+const GfTables& gf() {
+  static GfTables t;
+  return t;
+}
+
+// ---------------- CRC-32C slicing-by-8 ----------------
+struct CrcTables {
+  uint32_t t[8][256];
+  CrcTables() {
+    constexpr uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; k++) crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+      t[0][i] = crc;
+    }
+    for (int k = 1; k < 8; k++)
+      for (uint32_t i = 0; i < 256; i++)
+        t[k][i] = (t[k - 1][i] >> 8) ^ t[0][t[k - 1][i] & 0xFF];
+  }
+};
+
+const CrcTables& crc_tables() {
+  static CrcTables t;
+  return t;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t sweed_crc32c_update(uint32_t crc, const uint8_t* data, size_t n) {
+  const CrcTables& ct = crc_tables();
+  crc ^= 0xFFFFFFFFu;
+  size_t i = 0;
+  while (n - i >= 8) {
+    uint32_t lo;
+    std::memcpy(&lo, data + i, 4);
+    crc ^= lo;  // little-endian host assumed (x86/arm64)
+    crc = ct.t[7][crc & 0xFF] ^ ct.t[6][(crc >> 8) & 0xFF] ^
+          ct.t[5][(crc >> 16) & 0xFF] ^ ct.t[4][(crc >> 24) & 0xFF] ^
+          ct.t[3][data[i + 4]] ^ ct.t[2][data[i + 5]] ^
+          ct.t[1][data[i + 6]] ^ ct.t[0][data[i + 7]];
+    i += 8;
+  }
+  for (; i < n; i++) crc = (crc >> 8) ^ ct.t[0][(crc ^ data[i]) & 0xFF];
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// out[r*n .. r*n+n) = XOR over k of matrix[r*kk + c] * in[c*n .. c*n+n)
+// over GF(2^8). in: (kk, n) row-major contiguous; out: (out_rows, n).
+// Skip-list support for reconstruct: if in_present != nullptr, column c of the
+// matrix applies to input row c only when in_present[c] != 0, and matrix
+// columns are indexed by input-slot (so callers pass a full-width matrix with
+// zeros for absent slots or compact inputs — we use compact inputs here).
+void sweed_rs_matmul(const uint8_t* matrix, int out_rows, int kk, size_t n,
+                     const uint8_t* in, uint8_t* out) {
+  const GfTables& g = gf();
+  // Per (r, c) coefficient, use two 16-entry nibble tables so the inner loop
+  // is table lookups the compiler can unroll (the scalar cousin of klauspost's
+  // PSHUFB kernel).
+  for (int r = 0; r < out_rows; r++) {
+    uint8_t* dst = out + static_cast<size_t>(r) * n;
+    bool first = true;
+    for (int c = 0; c < kk; c++) {
+      uint8_t coef = matrix[r * kk + c];
+      const uint8_t* src = in + static_cast<size_t>(c) * n;
+      if (coef == 0) {
+        if (first) std::memset(dst, 0, n);
+        // note: klauspost also zero-fills then XORs; zero coef contributes 0
+        first = first && true;
+        continue;
+      }
+      uint8_t lo[16], hi[16];
+      for (int x = 0; x < 16; x++) {
+        lo[x] = g.mul(coef, static_cast<uint8_t>(x));
+        hi[x] = g.mul(coef, static_cast<uint8_t>(x << 4));
+      }
+      if (first) {
+        for (size_t j = 0; j < n; j++) {
+          uint8_t v = src[j];
+          dst[j] = lo[v & 0x0F] ^ hi[v >> 4];
+        }
+        first = false;
+      } else {
+        for (size_t j = 0; j < n; j++) {
+          uint8_t v = src[j];
+          dst[j] ^= lo[v & 0x0F] ^ hi[v >> 4];
+        }
+      }
+    }
+    if (first) std::memset(dst, 0, n);  // all-zero matrix row
+  }
+}
+
+// XOR n bytes of src into dst (helper for journal/parity delta paths).
+void sweed_xor_bytes(uint8_t* dst, const uint8_t* src, size_t n) {
+  for (size_t j = 0; j < n; j++) dst[j] ^= src[j];
+}
+
+}  // extern "C"

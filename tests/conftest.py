@@ -8,11 +8,23 @@ via __graft_entry__.dryrun_multichip).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The axon sitecustomize (PYTHONPATH=/root/.axon_site) force-registers the
+# tunneled TPU and sets jax_platforms="axon,cpu" at interpreter start; an env
+# var alone doesn't win. Override through the config API before any backend
+# initializes so tests run on the virtual 8-device CPU mesh. jax-free
+# environments still run the pure-numpy/C++ tests.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,96 @@
+"""GF(2^8) field + klauspost-compatible matrix tests."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import gf
+
+
+def test_field_tables():
+    assert gf.EXP_TABLE[0] == 1
+    assert gf.EXP_TABLE[1] == 2
+    assert gf.EXP_TABLE[8] == 0x1D  # alpha^8 reduced by poly 0x11D
+    assert gf.LOG_TABLE[1] == 0
+    assert gf.LOG_TABLE[2] == 1
+
+
+def test_mul_properties():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(1, 256, 3))
+        assert gf.gal_mul(a, b) == gf.gal_mul(b, a)
+        assert gf.gal_mul(a, gf.gal_mul(b, c)) == gf.gal_mul(gf.gal_mul(a, b), c)
+        # distributivity over XOR
+        assert gf.gal_mul(a, b ^ c) == gf.gal_mul(a, b) ^ gf.gal_mul(a, c)
+        assert gf.gal_mul(a, gf.gal_inverse(a)) == 1
+    assert gf.gal_mul(0, 7) == 0
+    assert gf.gal_mul(0x80, 2) == 0x1D
+
+
+def test_gal_exp_conventions():
+    # klauspost galExp edge cases
+    assert gf.gal_exp(0, 0) == 1
+    assert gf.gal_exp(0, 5) == 0
+    assert gf.gal_exp(7, 0) == 1
+    assert gf.gal_exp(2, 8) == 0x1D
+
+
+def test_mat_invert_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (1, 3, 10):
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf.mat_invert(m)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        assert np.array_equal(gf.mat_mul(m, inv), gf.mat_identity(n))
+
+
+def test_mat_invert_singular_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(np.linalg.LinAlgError):
+        gf.mat_invert(m)
+
+
+def test_build_matrix_rs_10_4_golden():
+    """Regression-pin the RS(10,4) parity rows of the inverted-Vandermonde
+    construction (klauspost buildMatrix). Any change here breaks bit-identity
+    with the reference's shards."""
+    m = gf.build_matrix(10, 14)
+    assert np.array_equal(m[:10], np.eye(10, dtype=np.uint8))
+    golden_parity = np.array(
+        [
+            [129, 150, 175, 184, 210, 196, 254, 232, 3, 2],
+            [150, 129, 184, 175, 196, 210, 232, 254, 2, 3],
+            [191, 214, 98, 10, 6, 111, 223, 183, 5, 4],
+            [214, 191, 10, 98, 111, 6, 183, 223, 4, 5],
+        ],
+        dtype=np.uint8,
+    )
+    assert np.array_equal(m[10:], golden_parity)
+
+
+def test_build_matrix_mds():
+    m = gf.build_matrix(6, 9)
+    for rows in itertools.combinations(range(9), 6):
+        gf.mat_invert(m[list(rows)])  # must not raise
+
+
+def test_bit_matrix_equivalence():
+    rng = np.random.default_rng(2)
+    mat = rng.integers(0, 256, (4, 10)).astype(np.uint8)
+    data = rng.integers(0, 256, (10, 500)).astype(np.uint8)
+    mt = gf.get_mul_table()
+    ref = np.zeros((4, 500), dtype=np.uint8)
+    for p in range(4):
+        for d in range(10):
+            ref[p] ^= mt[mat[p, d], data[d]]
+    bm = gf.gf_matrix_to_bit_matrix(mat)
+    bits = ((data[:, None, :] >> np.arange(8)[None, :, None]) & 1).reshape(80, 500)
+    out = ((bm.astype(np.int32) @ bits.astype(np.int32)) & 1).reshape(4, 8, 500)
+    packed = (out << np.arange(8)[None, :, None]).sum(axis=1).astype(np.uint8)
+    assert np.array_equal(ref, packed)

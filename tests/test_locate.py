@@ -1,0 +1,84 @@
+"""Interval math vs a brute-force model of the striping layout."""
+
+import numpy as np
+
+from seaweedfs_tpu.ec import locate
+from seaweedfs_tpu.ec.constants import DATA_SHARDS
+
+
+def brute_force_shard_map(large, small, dat_size):
+    """byte offset → (shard, shard_offset) by simulating the encoder layout."""
+    k = DATA_SHARDS
+    mapping = {}
+    pos = 0
+    row = 0
+    remaining = dat_size
+    # large rows
+    while remaining > large * k:
+        for i in range(k):
+            for b in range(large):
+                mapping[pos] = (i, row * large + b)
+                pos += 1
+        remaining -= large * k
+        row += 1
+    n_large = row
+    srow = 0
+    while remaining > 0:
+        for i in range(k):
+            for b in range(small):
+                if pos < dat_size:
+                    mapping[pos] = (i, n_large * large + srow * small + b)
+                pos += 1
+        remaining -= small * k
+        srow += 1
+    return mapping
+
+
+def test_locate_matches_brute_force():
+    large, small = 50, 10
+    for dat_size in (0, 5, 499, 500, 501, 760, 1200, 1503):
+        mapping = brute_force_shard_map(large, small, dat_size)
+        for offset in range(0, dat_size, 7):
+            size = min(23, dat_size - offset)
+            if size <= 0:
+                continue
+            got = b""
+            pos = offset
+            for iv in locate.locate_data(large, small, dat_size, offset, size):
+                sid, soff = iv.to_shard_id_and_offset(large, small)
+                for j in range(iv.size):
+                    assert mapping[pos] == (sid, soff + j), (
+                        dat_size,
+                        offset,
+                        pos,
+                    )
+                    pos += 1
+            assert pos == offset + size
+
+
+def test_edge_windows_where_reference_formulas_disagree():
+    """Exact-multiple and just-below-large-row dat sizes (the windows where
+    ec_locate.go's two row-count formulas diverge from the encoder) must
+    still locate every byte inside the shard files."""
+    large, small = 50, 10
+    for dat_size in (500, 499, 401, 1000, 999, 950):
+        mapping = brute_force_shard_map(large, small, dat_size)
+        shard_len = max(soff for _, soff in mapping.values()) + 1
+        for offset in range(0, dat_size, 13):
+            for iv in locate.locate_data(large, small, dat_size, offset, 1):
+                sid, soff = iv.to_shard_id_and_offset(large, small)
+                assert soff < shard_len + small, (dat_size, offset)
+                assert mapping[offset] == (sid, soff)
+
+
+def test_interval_sizes_sum():
+    ivs = locate.locate_data(1000, 10, 25000, 3, 14000)
+    assert sum(iv.size for iv in ivs) == 14000
+
+
+def test_large_to_small_transition():
+    # dat 11000, large 1000, small 100: 1 large row (10000), tail 1000
+    ivs = locate.locate_data(1000, 100, 11000, 9999, 3)
+    assert ivs[0].is_large_block and ivs[0].size == 1
+    assert not ivs[1].is_large_block
+    assert ivs[1].block_index == 0 and ivs[1].inner_block_offset == 0
