@@ -1,0 +1,118 @@
+"""Backend storage files: the IO abstraction under a volume's .dat.
+
+Mirrors `weed/storage/backend/backend.go:15-25` (BackendStorageFile):
+read_at/write_at/truncate/close/size/name/sync. DiskFile wraps a local file;
+MemoryFile supports tests and scratch volumes. A remote/S3-tier backend slots
+in here later (backend/s3_backend/s3_backend.go).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class BackendStorageFile:
+    def read_at(self, offset: int, size: int) -> bytes:
+        raise NotImplementedError
+
+    def write_at(self, offset: int, data: bytes) -> int:
+        raise NotImplementedError
+
+    def append(self, data: bytes) -> int:
+        """Write at current end; returns the offset written at."""
+        end = self.size()
+        self.write_at(end, data)
+        return end
+
+    def truncate(self, size: int) -> None:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class DiskFile(BackendStorageFile):
+    """Local file with positional IO (backend/disk_file.go)."""
+
+    def __init__(self, path: str, create: bool = False):
+        self._path = path
+        mode = "r+b" if os.path.exists(path) else ("w+b" if create else None)
+        if mode is None:
+            raise FileNotFoundError(path)
+        self._f = open(path, mode)
+        self._lock = threading.Lock()
+
+    def read_at(self, offset: int, size: int) -> bytes:
+        with self._lock:
+            self._f.seek(offset)
+            return self._f.read(size)
+
+    def write_at(self, offset: int, data: bytes) -> int:
+        with self._lock:
+            self._f.seek(offset)
+            return self._f.write(data)
+
+    def truncate(self, size: int) -> None:
+        with self._lock:
+            self._f.truncate(size)
+
+    def size(self) -> int:
+        with self._lock:
+            self._f.flush()
+            return os.fstat(self._f.fileno()).st_size
+
+    def name(self) -> str:
+        return self._path
+
+    def sync(self) -> None:
+        with self._lock:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+
+class MemoryFile(BackendStorageFile):
+    """In-memory backend (tests; analog of backend/memory_map)."""
+
+    def __init__(self, name: str = "<memory>"):
+        self._buf = bytearray()
+        self._name = name
+        self._lock = threading.Lock()
+
+    def read_at(self, offset: int, size: int) -> bytes:
+        with self._lock:
+            return bytes(self._buf[offset : offset + size])
+
+    def write_at(self, offset: int, data: bytes) -> int:
+        with self._lock:
+            end = offset + len(data)
+            if end > len(self._buf):
+                self._buf.extend(b"\x00" * (end - len(self._buf)))
+            self._buf[offset:end] = data
+            return len(data)
+
+    def truncate(self, size: int) -> None:
+        with self._lock:
+            del self._buf[size:]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def name(self) -> str:
+        return self._name

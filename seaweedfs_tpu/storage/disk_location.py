@@ -1,0 +1,128 @@
+"""DiskLocation: one data directory holding volumes and EC shards.
+
+Mirrors `weed/storage/disk_location.go` (+ `disk_location_ec.go`): scans the
+directory on startup, loads every `<collection>_<vid>.dat` / `<vid>.dat`
+volume and every `.ecx`-bearing EC volume, and watches free space to flip
+volumes read-only (CheckDiskSpace, disk_location.go:314).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Optional
+
+from ..ec.ec_volume import EcVolume
+from .volume import Volume
+
+
+def parse_volume_base_name(name: str) -> tuple[str, int]:
+    """'col_3' → ('col', 3); '3' → ('', 3). Raises on non-volume names."""
+    if "_" in name:
+        collection, vid_str = name.rsplit("_", 1)
+    else:
+        collection, vid_str = "", name
+    return collection, int(vid_str)
+
+
+class DiskLocation:
+    def __init__(
+        self,
+        directory: str,
+        max_volume_count: int = 7,
+        min_free_space_ratio: float = 0.01,
+    ):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_volume_count = max_volume_count
+        self.min_free_space_ratio = min_free_space_ratio
+        self.volumes: dict[int, Volume] = {}
+        self.ec_volumes: dict[int, EcVolume] = {}
+        self._lock = threading.RLock()
+
+    # -- startup loading (disk_location.go:104-160) --------------------------
+    def load_existing_volumes(self) -> None:
+        with self._lock:
+            for entry in sorted(os.listdir(self.directory)):
+                path = os.path.join(self.directory, entry)
+                if not os.path.isfile(path):
+                    continue
+                base, ext = os.path.splitext(entry)
+                try:
+                    if ext == ".dat":
+                        collection, vid = parse_volume_base_name(base)
+                        if vid not in self.volumes:
+                            self.volumes[vid] = Volume(
+                                self.directory, collection, vid,
+                                create_if_missing=False,
+                            )
+                    elif ext == ".ecx":
+                        collection, vid = parse_volume_base_name(base)
+                        if vid not in self.ec_volumes:
+                            ev = EcVolume(self.directory, collection, vid)
+                            if ev.shards:
+                                self.ec_volumes[vid] = ev
+                            else:
+                                ev.close()
+                except (ValueError, FileNotFoundError):
+                    continue  # not a volume file
+
+    # -- volume management ---------------------------------------------------
+    def add_volume(self, volume: Volume) -> None:
+        with self._lock:
+            self.volumes[volume.id] = volume
+
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        return self.volumes.get(vid)
+
+    def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        return self.ec_volumes.get(vid)
+
+    def unload_volume(self, vid: int) -> bool:
+        with self._lock:
+            v = self.volumes.pop(vid, None)
+            if v is None:
+                return False
+            v.close()
+            return True
+
+    def delete_volume(self, vid: int) -> bool:
+        with self._lock:
+            v = self.volumes.pop(vid, None)
+            if v is None:
+                return False
+            v.destroy()
+            return True
+
+    def unload_ec_volume(self, vid: int) -> bool:
+        with self._lock:
+            ev = self.ec_volumes.pop(vid, None)
+            if ev is None:
+                return False
+            ev.close()
+            return True
+
+    def volume_count(self) -> int:
+        return len(self.volumes)
+
+    # -- disk watchdog (disk_location.go:314-345) ----------------------------
+    def check_disk_space(self) -> bool:
+        """Flips all volumes read-only when free space is low; returns the
+        current is-low state."""
+        usage = shutil.disk_usage(self.directory)
+        low = usage.free / usage.total < self.min_free_space_ratio
+        if low:
+            with self._lock:
+                for v in self.volumes.values():
+                    v.read_only = True
+        return low
+
+    def close(self) -> None:
+        with self._lock:
+            for v in self.volumes.values():
+                v.close()
+            for ev in self.ec_volumes.values():
+                ev.close()
+            self.volumes.clear()
+            self.ec_volumes.clear()

@@ -1,0 +1,182 @@
+"""Needle maps: per-volume NeedleId → (offset, size) index.
+
+Mirrors `weed/storage/needle_map.go` + `needle_map_memory.go`: every mutation
+is also appended to the .idx file (the map's durable log / checkpoint);
+deletes append a (key, tombstone_offset, -1) entry. Counters match the
+reference's mapMetric (`needle_map_metric.go`): FileCount counts every put
+ever applied (including overwrites), DeletionCounter counts both explicit
+deletes and overwrite-shadowed needles.
+
+The reference's CompactMap packs entries into 16 bytes each; a Python dict
+costs ~100 bytes/entry, so CompactNeedleMap here keeps the hot map in a plain
+dict for speed but the design isolates it behind NeedleMapper so a
+numpy-packed variant can swap in for RAM-constrained deployments.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+from dataclasses import dataclass
+from typing import BinaryIO, Callable, Iterator, Optional
+
+from . import idx as idx_mod
+from .types import OFFSET_SIZE, TOMBSTONE_FILE_SIZE, size_is_valid
+
+
+@dataclass(frozen=True)
+class NeedleValue:
+    key: int
+    offset: int  # actual byte offset
+    size: int
+
+
+class NeedleMapper:
+    """Interface (needle_map.go:21-34)."""
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        raise NotImplementedError
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        raise NotImplementedError
+
+    def delete(self, key: int, offset: int) -> None:
+        raise NotImplementedError
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def destroy(self) -> None:
+        pass
+
+
+class CompactNeedleMap(NeedleMapper):
+    """In-memory map + .idx append log (NeedleMapInMemory kind)."""
+
+    def __init__(self, index_file: BinaryIO, offset_size: int = OFFSET_SIZE):
+        self._m: dict[int, tuple[int, int]] = {}
+        self._index_file = index_file
+        self._lock = threading.Lock()
+        self._offset_size = offset_size
+        # mapMetric counters
+        self.file_counter = 0
+        self.file_byte_counter = 0
+        self.deletion_counter = 0
+        self.deletion_byte_counter = 0
+        self.max_file_key = 0
+
+    # -- loading (needle_map_memory.go:30-51) --------------------------------
+    @classmethod
+    def load(cls, index_file: BinaryIO, offset_size: int = OFFSET_SIZE) -> "CompactNeedleMap":
+        nm = cls(index_file, offset_size)
+        for key, offset, size in idx_mod.iter_index_file(index_file, offset_size):
+            nm.max_file_key = max(nm.max_file_key, key)
+            if offset != 0 and size_is_valid(size):
+                nm.file_counter += 1
+                nm.file_byte_counter += size
+                old = nm._m.get(key)
+                nm._m[key] = (offset, size)
+                if old is not None and old[0] != 0 and size_is_valid(old[1]):
+                    nm.deletion_counter += 1
+                    nm.deletion_byte_counter += old[1]
+            else:
+                old = nm._m.get(key)
+                nm.deletion_counter += 1
+                if old is not None and size_is_valid(old[1]):
+                    nm.deletion_byte_counter += old[1]
+                    # mark deleted in place, preserving the original offset
+                    # (compact_map.go Delete negates Size so read-deleted
+                    # can still find the old record); absent keys are a
+                    # no-op like the reference's m.Delete
+                    nm._m[key] = (old[0], -old[1])
+        index_file.seek(0, io.SEEK_END)
+        return nm
+
+    def _append_entry(self, key: int, offset: int, size: int) -> None:
+        entry = idx_mod.pack_entry(key, offset, size, self._offset_size)
+        self._index_file.seek(0, io.SEEK_END)
+        self._index_file.write(entry)
+
+    # -- mutations -----------------------------------------------------------
+    def put(self, key: int, offset: int, size: int) -> None:
+        with self._lock:
+            old = self._m.get(key)
+            self._m[key] = (offset, size)
+            self.max_file_key = max(self.max_file_key, key)
+            self.file_counter += 1
+            self.file_byte_counter += size
+            if old is not None and old[0] != 0 and size_is_valid(old[1]):
+                self.deletion_counter += 1
+                self.deletion_byte_counter += old[1]
+            self._append_entry(key, offset, size)
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        v = self._m.get(key)
+        if v is None:
+            return None
+        return NeedleValue(key, v[0], v[1])
+
+    def delete(self, key: int, offset: int) -> None:
+        """offset = where the tombstone needle was appended in the .dat.
+
+        The in-memory entry keeps the ORIGINAL offset with a negated size
+        (compact_map.go Delete) so deleted records remain addressable for
+        read-deleted flows; only the .idx log records the tombstone offset.
+        """
+        with self._lock:
+            old = self._m.get(key)
+            if old is not None and size_is_valid(old[1]):
+                self.deletion_counter += 1
+                self.deletion_byte_counter += old[1]
+                self._m[key] = (old[0], -old[1])
+            self._append_entry(key, offset, TOMBSTONE_FILE_SIZE)
+
+    # -- queries -------------------------------------------------------------
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for key in sorted(self._m):
+            offset, size = self._m[key]
+            fn(NeedleValue(key, offset, size))
+
+    def items(self) -> Iterator[NeedleValue]:
+        for key, (offset, size) in self._m.items():
+            yield NeedleValue(key, offset, size)
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    def content_size(self) -> int:
+        return self.file_byte_counter
+
+    def deleted_size(self) -> int:
+        return self.deletion_byte_counter
+
+    def file_count(self) -> int:
+        return self.file_counter
+
+    def deleted_count(self) -> int:
+        return self.deletion_counter
+
+    def index_file_size(self) -> int:
+        try:
+            return os.fstat(self._index_file.fileno()).st_size
+        except (OSError, AttributeError, io.UnsupportedOperation):
+            self._index_file.seek(0, io.SEEK_END)
+            return self._index_file.tell()
+
+    def sync(self) -> None:
+        self._index_file.flush()
+        try:
+            os.fsync(self._index_file.fileno())
+        except (OSError, AttributeError, io.UnsupportedOperation):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._index_file.flush()
+        except ValueError:
+            pass
+        self._index_file.close()

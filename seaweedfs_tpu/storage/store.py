@@ -1,0 +1,255 @@
+"""Store: all disk locations of one volume server; routes needle ops.
+
+Mirrors `weed/storage/store.go` + `store_ec.go`: volume CRUD across
+DiskLocations, heartbeat stat collection with delta queues for the master
+stream, and the EC read path with on-the-fly reconstruction:
+
+    local shard read → remote shard fetch (injected callback; the volume
+    server wires this to gRPC in the cluster layer) → reconstruction from
+    ≥k sibling shards via the EC codec (TPU/CPU) — store_ec.go:122-375.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..ec.codec import Codec, get_codec
+from ..ec.constants import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE
+from ..ec.ec_volume import EcVolume, NeedsShardError
+from ..ec.ec_volume import NotFoundError as EcNotFoundError
+from .disk_location import DiskLocation
+from .needle import Needle
+from .replica_placement import ReplicaPlacement
+from .ttl import EMPTY_TTL, TTL, read_ttl
+from .volume import NotFoundError, Volume
+
+# remote_reader(vid, shard_id, offset, size) -> bytes | None
+RemoteShardReader = Callable[[int, int, int, int], Optional[bytes]]
+
+
+class Store:
+    def __init__(
+        self,
+        directories: list[str],
+        ip: str = "localhost",
+        port: int = 8080,
+        public_url: str = "",
+        ec_backend: Optional[str] = None,
+    ):
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.locations = [DiskLocation(d) for d in directories]
+        for loc in self.locations:
+            loc.load_existing_volumes()
+        self._ec_codec: Optional[Codec] = None
+        self._ec_backend = ec_backend
+        self.remote_shard_reader: Optional[RemoteShardReader] = None
+        # delta queues consumed by the heartbeat loop (store.go:33-50)
+        self.new_volumes: deque[int] = deque()
+        self.deleted_volumes: deque[int] = deque()
+        self.new_ec_shards: deque[tuple[int, int]] = deque()
+        self.deleted_ec_shards: deque[tuple[int, int]] = deque()
+        self._lock = threading.RLock()
+
+    @property
+    def ec_codec(self) -> Codec:
+        if self._ec_codec is None:
+            self._ec_codec = get_codec(self._ec_backend)
+        return self._ec_codec
+
+    # -- volume management (store.go:120-200) --------------------------------
+    def add_volume(
+        self,
+        vid: int,
+        collection: str = "",
+        replica_placement: str | ReplicaPlacement = "000",
+        ttl: str | TTL = "",
+        preallocate: int = 0,
+    ) -> Volume:
+        if self.find_volume(vid) is not None:
+            raise ValueError(f"volume {vid} already exists")
+        if isinstance(replica_placement, str):
+            replica_placement = ReplicaPlacement.from_string(replica_placement)
+        if isinstance(ttl, str):
+            ttl = read_ttl(ttl) if ttl else EMPTY_TTL
+        loc = self._pick_location()
+        v = Volume(loc.directory, collection, vid, replica_placement, ttl)
+        loc.add_volume(v)
+        self.new_volumes.append(vid)
+        return v
+
+    def _pick_location(self) -> DiskLocation:
+        return min(self.locations, key=lambda l: l.volume_count())
+
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            v = loc.find_volume(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        for loc in self.locations:
+            ev = loc.find_ec_volume(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    def delete_volume(self, vid: int) -> bool:
+        for loc in self.locations:
+            if loc.delete_volume(vid):
+                self.deleted_volumes.append(vid)
+                return True
+        return False
+
+    def mark_volume_readonly(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = True
+        return True
+
+    def mark_volume_writable(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = False
+        return True
+
+    # -- needle ops (store.go:299-340) ---------------------------------------
+    def write_volume_needle(
+        self, vid: int, n: Needle, fsync: bool = False
+    ) -> tuple[int, int, bool]:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return v.write_needle(n, fsync=fsync)
+
+    def delete_volume_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            ev = self.find_ec_volume(vid)
+            if ev is not None:
+                ev.delete_needle(n.id)
+                return 0
+            raise NotFoundError(f"volume {vid} not found")
+        return v.delete_needle(n)
+
+    def read_volume_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read_needle(n)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return self.read_ec_shard_needle(ev, n)
+        raise NotFoundError(f"volume {vid} not found")
+
+    # -- EC read path (store_ec.go:122-375) ----------------------------------
+    def read_ec_shard_needle(self, ev: EcVolume, n: Needle) -> int:
+        offset, size, intervals = ev.locate_needle(n.id)
+        blob = b"".join(self._read_interval(ev, iv) for iv in intervals)
+        m = Needle.from_bytes(blob, size, ev.version)
+        if m.id != n.id:
+            raise EcNotFoundError(f"unexpected needle {m.id:x} != {n.id:x}")
+        n.__dict__.update(m.__dict__)
+        return len(n.data)
+
+    def _read_interval(self, ev: EcVolume, interval) -> bytes:
+        try:
+            return ev.read_interval_local(interval)
+        except NeedsShardError:
+            sid, soff = interval.to_shard_id_and_offset(
+                LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, ev.data_shards
+            )
+            # 1. remote shard holder (wired to gRPC by the volume server)
+            if self.remote_shard_reader is not None:
+                data = self.remote_shard_reader(ev.id, sid, soff, interval.size)
+                if data is not None and len(data) == interval.size:
+                    return data
+            # 2. degraded mode: reconstruct from sibling shards
+            return self._recover_interval(ev, sid, soff, interval.size)
+
+    def _recover_interval(
+        self, ev: EcVolume, missing_shard: int, offset: int, size: int
+    ) -> bytes:
+        """Fetch the same byte range from ≥k sibling shards and RS-decode
+        (recoverOneRemoteEcShardInterval, store_ec.go:322)."""
+        codec = self.ec_codec
+        shards: list[Optional[np.ndarray]] = [None] * ev.total_shards
+        have = 0
+        for sid in range(ev.total_shards):
+            if sid == missing_shard:
+                continue
+            local = ev.shards.get(sid)
+            buf = None
+            if local is not None:
+                buf = local.read_at(offset, size)
+            elif self.remote_shard_reader is not None:
+                buf = self.remote_shard_reader(ev.id, sid, offset, size)
+            if buf is not None and len(buf) == size:
+                shards[sid] = np.frombuffer(buf, dtype=np.uint8)
+                have += 1
+            if have >= ev.data_shards:
+                break
+        if have < ev.data_shards:
+            raise EcNotFoundError(
+                f"volume {ev.id} shard {missing_shard}: only {have} shards reachable"
+            )
+        rebuilt = codec.reconstruct(shards, data_only=missing_shard < ev.data_shards)
+        return rebuilt[missing_shard].tobytes()
+
+    # -- heartbeat (store.go:204-297) ----------------------------------------
+    def collect_heartbeat(self) -> dict:
+        volumes = []
+        max_file_key = 0
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                max_file_key = max(max_file_key, v.max_file_key())
+                volumes.append(
+                    {
+                        "id": v.id,
+                        "size": v.size(),
+                        "collection": v.collection,
+                        "file_count": v.file_count(),
+                        "delete_count": v.deleted_count(),
+                        "deleted_byte_count": v.deleted_size(),
+                        "read_only": v.read_only,
+                        "replica_placement": v.super_block.replica_placement.to_byte(),
+                        "version": v.version,
+                        "ttl": v.ttl.to_uint32(),
+                        "compact_revision": v.super_block.compaction_revision,
+                    }
+                )
+        return {
+            "ip": self.ip,
+            "port": self.port,
+            "public_url": self.public_url,
+            "max_file_key": max_file_key,
+            "max_volume_count": sum(l.max_volume_count for l in self.locations),
+            "volumes": volumes,
+        }
+
+    def collect_ec_heartbeat(self) -> dict:
+        ec_shards = []
+        for loc in self.locations:
+            for ev in loc.ec_volumes.values():
+                ec_shards.append(
+                    {
+                        "id": ev.id,
+                        "collection": ev.collection,
+                        "ec_index_bits": sum(1 << sid for sid in ev.shard_ids()),
+                    }
+                )
+        return {"ip": self.ip, "port": self.port, "ec_shards": ec_shards}
+
+    def close(self) -> None:
+        for loc in self.locations:
+            loc.close()

@@ -1,0 +1,187 @@
+"""Volume engine: write/read/delete, durability, integrity, vacuum."""
+
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.needle import FLAG_HAS_LAST_MODIFIED, FLAG_HAS_TTL, Needle
+from seaweedfs_tpu.storage.ttl import read_ttl
+from seaweedfs_tpu.storage.volume import (
+    DeletedError,
+    NotFoundError,
+    Volume,
+    VolumeError,
+)
+
+
+def make_needle(nid, data, cookie=0x1234):
+    return Needle(cookie=cookie, id=nid, data=data)
+
+
+@pytest.fixture()
+def vol(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    yield v
+    v.close()
+
+
+def test_write_read_roundtrip(vol):
+    offset, size, unchanged = vol.write_needle(make_needle(1, b"hello world"))
+    assert not unchanged and offset == 8  # right after superblock
+    n = Needle(id=1)
+    assert vol.read_needle(n) == 11
+    assert n.data == b"hello world"
+    assert n.cookie == 0x1234
+
+
+def test_write_many_and_stats(vol):
+    rng = np.random.default_rng(0)
+    blobs = {}
+    for i in range(1, 101):
+        blobs[i] = rng.integers(0, 256, int(rng.integers(1, 5000)), dtype=np.uint8).tobytes()
+        vol.write_needle(make_needle(i, blobs[i]))
+    assert vol.file_count() == 100
+    assert vol.max_file_key() == 100
+    for i, want in blobs.items():
+        n = Needle(id=i)
+        vol.read_needle(n)
+        assert n.data == want
+    assert vol.size() % 8 == 0
+
+
+def test_overwrite_same_cookie(vol):
+    vol.write_needle(make_needle(5, b"v1"))
+    vol.write_needle(make_needle(5, b"v2"))
+    n = Needle(id=5)
+    vol.read_needle(n)
+    assert n.data == b"v2"
+    assert vol.deleted_count() == 1  # shadowed needle counts as garbage
+
+
+def test_overwrite_cookie_mismatch_rejected(vol):
+    vol.write_needle(make_needle(5, b"v1", cookie=0xAAAA))
+    with pytest.raises(VolumeError, match="cookie"):
+        vol.write_needle(make_needle(5, b"v2", cookie=0xBBBB))
+
+
+def test_unchanged_write_detected(vol):
+    vol.write_needle(make_needle(7, b"same-bytes"))
+    _, _, unchanged = vol.write_needle(make_needle(7, b"same-bytes"))
+    assert unchanged
+
+
+def test_delete_then_read_raises(vol):
+    vol.write_needle(make_needle(9, b"doomed"))
+    # returns the needle map's Size field (data + field overhead), like the
+    # reference's syncDelete returning nv.Size
+    assert vol.delete_needle(Needle(id=9, cookie=0x1234)) == 4 + len(b"doomed") + 1
+    with pytest.raises(DeletedError):
+        vol.read_needle(Needle(id=9))
+    # deleting again is a no-op
+    assert vol.delete_needle(Needle(id=9, cookie=0x1234)) == 0
+
+
+def test_read_missing_raises(vol):
+    with pytest.raises(NotFoundError):
+        vol.read_needle(Needle(id=404))
+
+
+def test_persistence_across_reload(tmp_path):
+    v = Volume(str(tmp_path), "col", 3)
+    v.write_needle(make_needle(1, b"persisted"))
+    v.write_needle(make_needle(2, b"also persisted"))
+    v.delete_needle(Needle(id=1, cookie=0x1234))
+    v.close()
+
+    v2 = Volume(str(tmp_path), "col", 3, create_if_missing=False)
+    with pytest.raises(DeletedError):
+        v2.read_needle(Needle(id=1))
+    n = Needle(id=2)
+    v2.read_needle(n)
+    assert n.data == b"also persisted"
+    assert v2.super_block.version == 3
+    v2.close()
+
+
+def test_torn_idx_tail_truncated(tmp_path):
+    v = Volume(str(tmp_path), "", 4)
+    v.write_needle(make_needle(1, b"good"))
+    v.close()
+    # simulate a torn append: a valid-shaped idx entry pointing past the .dat
+    from seaweedfs_tpu.storage import idx
+
+    base = v.file_name()
+    with open(base + ".idx", "ab") as f:
+        f.write(idx.pack_entry(2, 8 * 10**6, 123))
+    v2 = Volume(str(tmp_path), "", 4, create_if_missing=False)
+    assert v2.nm.get(2) is None, "torn entry must be dropped"
+    n = Needle(id=1)
+    v2.read_needle(n)
+    assert n.data == b"good"
+    v2.close()
+
+
+def test_idx_rebuild_from_dat(tmp_path):
+    v = Volume(str(tmp_path), "", 5)
+    for i in range(1, 21):
+        v.write_needle(make_needle(i, f"data-{i}".encode()))
+    v.delete_needle(Needle(id=3, cookie=0x1234))
+    v.close()
+    os.remove(v.file_name() + ".idx")
+
+    v2 = Volume(str(tmp_path), "", 5, create_if_missing=False)
+    n = Needle(id=10)
+    v2.read_needle(n)
+    assert n.data == b"data-10"
+    with pytest.raises(DeletedError):
+        v2.read_needle(Needle(id=3))
+    v2.close()
+
+
+def test_vacuum_compact(tmp_path):
+    v = Volume(str(tmp_path), "", 6)
+    rng = np.random.default_rng(1)
+    for i in range(1, 51):
+        v.write_needle(make_needle(i, rng.integers(0, 256, 2000, dtype=np.uint8).tobytes()))
+    for i in range(1, 41):
+        v.delete_needle(Needle(id=i, cookie=0x1234))
+    size_before = v.size()
+    assert v.garbage_level() > 0.5
+    rev_before = v.super_block.compaction_revision
+
+    v.compact()
+
+    assert v.size() < size_before // 2
+    assert v.super_block.compaction_revision == rev_before + 1
+    for i in range(41, 51):
+        n = Needle(id=i)
+        v.read_needle(n)
+        assert len(n.data) == 2000
+    for i in range(1, 41):
+        with pytest.raises((DeletedError, NotFoundError)):
+            v.read_needle(Needle(id=i))
+    # garbage reclaimed
+    assert v.garbage_level() == 0.0
+    v.close()
+
+
+def test_ttl_expiry(tmp_path):
+    v = Volume(str(tmp_path), "", 7)
+    n = make_needle(1, b"short lived")
+    n.ttl = read_ttl("1m")
+    n.last_modified = 1  # epoch 1970 → long expired
+    n.set_flag(FLAG_HAS_TTL)
+    n.set_flag(FLAG_HAS_LAST_MODIFIED)
+    v.write_needle(n)
+    with pytest.raises(NotFoundError, match="expired"):
+        v.read_needle(Needle(id=1))
+    v.close()
+
+
+def test_readonly_rejects_writes(vol):
+    vol.read_only = True
+    with pytest.raises(VolumeError, match="read only"):
+        vol.write_needle(make_needle(1, b"x"))
+    with pytest.raises(VolumeError, match="read only"):
+        vol.delete_needle(Needle(id=1))
