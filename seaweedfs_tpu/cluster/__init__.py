@@ -1,0 +1,7 @@
+"""Cluster plane: topology tree, volume layout/growth, sequencer, master.
+
+Python reimplementation of `weed/topology` + `weed/sequence` + the master's
+logic from `weed/server/master_*.go`, transport-agnostic: the master core
+operates on plain dicts/objects so it can be driven in-process (tests mirror
+the reference's JSON-fixture topology tests) or wrapped by HTTP/gRPC servers.
+"""

@@ -1,0 +1,30 @@
+"""Needle-id sequencers (weed/sequence/sequence.go + memory_sequencer.go)."""
+
+from __future__ import annotations
+
+import threading
+
+
+class MemorySequencer:
+    """Monotonic batch allocator; the master checkpoints state via raft/
+    snapshot in the reference (raft_server.go:30) — here persistence hooks
+    are the caller's (set_max on recovery)."""
+
+    def __init__(self, start: int = 1):
+        self._counter = max(1, start)
+        self._lock = threading.Lock()
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            start = self._counter
+            self._counter += count
+            return start
+
+    def set_max(self, seen: int) -> None:
+        with self._lock:
+            if seen > self._counter:
+                self._counter = seen + 1
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._counter

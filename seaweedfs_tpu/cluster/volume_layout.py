@@ -1,0 +1,142 @@
+"""VolumeLayout: writable/readonly volume sets per (collection, rp, ttl).
+
+Mirrors `weed/topology/volume_layout.go`: tracks vid → replica locations,
+keeps the writable list consistent with replica counts and sizes, and picks
+random writable volumes for assignment.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import TYPE_CHECKING, Optional
+
+from ..storage.replica_placement import ReplicaPlacement
+from ..storage.ttl import TTL
+
+if TYPE_CHECKING:
+    from .topology import DataNode, VolumeInfo
+
+
+class VolumeLayout:
+    def __init__(
+        self,
+        rp: ReplicaPlacement,
+        ttl: TTL,
+        volume_size_limit: int,
+    ):
+        self.rp = rp
+        self.ttl = ttl
+        self.volume_size_limit = volume_size_limit
+        self.vid2location: dict[int, list["DataNode"]] = {}
+        self.writables: list[int] = []
+        self.readonly_volumes: set[int] = set()
+        self.oversized_volumes: set[int] = set()
+        self._lock = threading.RLock()
+
+    # -- registration (volume_layout.go:104-200) -----------------------------
+    def register_volume(self, vi: "VolumeInfo", dn: "DataNode") -> None:
+        with self._lock:
+            locs = self.vid2location.setdefault(vi.id, [])
+            if dn not in locs:
+                locs.append(dn)
+            self.ensure_correct_writables(vi)
+
+    def unregister_volume(self, vi: "VolumeInfo", dn: "DataNode") -> None:
+        with self._lock:
+            locs = self.vid2location.get(vi.id)
+            if locs and dn in locs:
+                locs.remove(dn)
+            if not locs:
+                self.vid2location.pop(vi.id, None)
+                self._remove_from_writable(vi.id)
+            else:
+                self._ensure_writable_state(vi.id)
+
+    def ensure_correct_writables(self, vi: "VolumeInfo") -> None:
+        with self._lock:
+            if vi.read_only:
+                self.readonly_volumes.add(vi.id)
+            else:
+                self.readonly_volumes.discard(vi.id)
+            if vi.size >= self.volume_size_limit:
+                self.oversized_volumes.add(vi.id)
+            else:
+                # a vacuumed volume can shrink back under the limit
+                self.oversized_volumes.discard(vi.id)
+            self._ensure_writable_state(vi.id)
+
+    def _ensure_writable_state(self, vid: int) -> None:
+        locs = self.vid2location.get(vid, [])
+        enough_replicas = len(locs) >= self.rp.copy_count()
+        writable = (
+            enough_replicas
+            and vid not in self.readonly_volumes
+            and vid not in self.oversized_volumes
+        )
+        if writable:
+            if vid not in self.writables:
+                self.writables.append(vid)
+        else:
+            self._remove_from_writable(vid)
+
+    def _remove_from_writable(self, vid: int) -> None:
+        if vid in self.writables:
+            self.writables.remove(vid)
+
+    def set_volume_unavailable(self, vid: int, dn: "DataNode") -> bool:
+        """Node lost (volume_layout.go:357): drop this replica; volume leaves
+        the writable set when replicas fall below the placement count."""
+        with self._lock:
+            locs = self.vid2location.get(vid)
+            if locs and dn in locs:
+                locs.remove(dn)
+            if not locs:
+                self.vid2location.pop(vid, None)
+            self._ensure_writable_state(vid)
+            return vid in self.writables
+
+    def set_volume_readonly(self, vid: int) -> None:
+        with self._lock:
+            self.readonly_volumes.add(vid)
+            self._remove_from_writable(vid)
+
+    # -- assignment (volume_layout.go:267-300) -------------------------------
+    def pick_for_write(
+        self, data_center: str = ""
+    ) -> tuple[int, list["DataNode"]]:
+        with self._lock:
+            if not self.writables:
+                raise NoWritableVolumesError("no more writable volumes")
+            if not data_center:
+                vid = random.choice(self.writables)
+                return vid, list(self.vid2location[vid])
+            candidates = []
+            for vid in self.writables:
+                locs = self.vid2location.get(vid, [])
+                if any(dn.get_data_center().id == data_center for dn in locs):
+                    candidates.append((vid, locs))
+            if not candidates:
+                raise NoWritableVolumesError(
+                    f"no writable volumes in data center {data_center}"
+                )
+            vid, locs = random.choice(candidates)
+            return vid, list(locs)
+
+    def active_volume_count(self) -> int:
+        return len(self.writables)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "replication": str(self.rp),
+                "ttl": str(self.ttl),
+                "writables": sorted(self.writables),
+                "readonly": sorted(self.readonly_volumes),
+                "oversized": sorted(self.oversized_volumes),
+                "volume_count": len(self.vid2location),
+            }
+
+
+class NoWritableVolumesError(Exception):
+    pass

@@ -110,7 +110,10 @@ class Volume:
         idx_path = base + ".idx"
         if not os.path.exists(idx_path) and dat_exists:
             self._rebuild_index(idx_path)
-        idx_file = open(idx_path, "a+b")
+        # unbuffered: .idx appends must be immediately visible to other
+        # readers of the file (EC encode reads the .idx of a live volume).
+        # One 16-byte write(2) per put matches the reference's os.File.Write.
+        idx_file = open(idx_path, "a+b", buffering=0)
         self.nm = CompactNeedleMap.load(idx_file, offset_size)
         self.last_append_at_ns = self._check_and_fix_integrity(idx_file)
 
@@ -446,7 +449,7 @@ class Volume:
         self.super_block = SuperBlock.from_bytes(
             self.data_backend.read_at(0, SUPER_BLOCK_SIZE + extra_size)
         )
-        idx_file = open(base + ".idx", "a+b")
+        idx_file = open(base + ".idx", "a+b", buffering=0)
         self.nm = CompactNeedleMap.load(idx_file, self.offset_size)
 
     # -- lifecycle -----------------------------------------------------------
