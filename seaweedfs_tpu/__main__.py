@@ -1,0 +1,272 @@
+"""`python -m seaweedfs_tpu` — the CLI (reference: the `weed` command).
+
+Subcommands (weed/command/command.go:11-32 equivalents):
+    master     run a master server
+    volume     run a volume server
+    server     master + volume(s) in one process (weed server)
+    upload     assign + upload files
+    download   fetch by fid
+    delete     delete by fid
+    benchmark  the reference's `weed benchmark` (1KB files, concurrency 16)
+    ec.encode  erasure-code a volume via its server
+    shell      admin REPL (seaweedfs_tpu.shell)
+    version
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def cmd_master(args):
+    from .server.master_server import MasterServer
+
+    ms = MasterServer(
+        host=args.ip,
+        port=args.port,
+        volume_size_limit_mb=args.volume_size_limit_mb,
+        default_replication=args.default_replication,
+    ).start()
+    print(f"master listening on {ms.url}")
+    _wait_forever()
+
+
+def cmd_volume(args):
+    from .server.volume_server import VolumeServer
+
+    dirs = args.dir.split(",")
+    vs = VolumeServer(
+        dirs,
+        host=args.ip,
+        port=args.port,
+        master_url=args.mserver,
+        data_center=args.data_center,
+        rack=args.rack,
+        max_volume_count=args.max,
+        ec_backend=args.ec_backend or None,
+    ).start()
+    print(f"volume server on {vs.host}:{vs.port} → master {args.mserver}")
+    _wait_forever()
+
+
+def cmd_server(args):
+    from .server.master_server import MasterServer
+    from .server.volume_server import VolumeServer
+
+    ms = MasterServer(host=args.ip, port=args.master_port).start()
+    dirs = args.dir.split(",")
+    vs = VolumeServer(
+        dirs,
+        host=args.ip,
+        port=args.port,
+        master_url=ms.url,
+        max_volume_count=args.max,
+        ec_backend=args.ec_backend or None,
+    ).start()
+    print(f"server: master {ms.url}, volume {vs.host}:{vs.port}")
+    _wait_forever()
+
+
+def cmd_upload(args):
+    from . import operation
+
+    for path in args.files:
+        with open(path, "rb") as f:
+            data = f.read()
+        fid = operation.submit(
+            args.master,
+            data,
+            name=os.path.basename(path),
+            replication=args.replication,
+            collection=args.collection,
+            ttl=args.ttl,
+        )
+        print(f"{path}\t{fid}")
+
+
+def cmd_download(args):
+    from . import operation
+
+    data = operation.download(args.master, args.fid)
+    if args.output == "-":
+        sys.stdout.buffer.write(data)
+    else:
+        with open(args.output, "wb") as f:
+            f.write(data)
+        print(f"{args.fid} → {args.output} ({len(data)} bytes)")
+
+
+def cmd_delete(args):
+    from . import operation
+
+    n = operation.delete_files(args.master, args.fids)
+    print(f"deleted {n}/{len(args.fids)}")
+
+
+def cmd_ec_encode(args):
+    from .server.http_util import http_json
+    from . import operation
+
+    locs = operation.lookup(args.master, args.volume)
+    if not locs:
+        print(f"volume {args.volume} not found", file=sys.stderr)
+        sys.exit(1)
+    r = http_json(
+        "POST", f"http://{locs[0]['url']}/admin/ec/generate?volume={args.volume}"
+    )
+    print(r)
+
+
+def cmd_benchmark(args):
+    """The reference's benchmark (command/benchmark.go; defaults: 1KB files,
+    c=16, n=1048576 — scaled down by default here; use -n to match)."""
+    import concurrent.futures
+    import secrets
+
+    from . import operation
+
+    payload = secrets.token_bytes(args.size)
+    fids: list[str] = []
+    latencies: list[float] = []
+
+    def one_write(i):
+        t0 = time.perf_counter()
+        fid = operation.submit(args.master, payload, collection=args.collection)
+        return fid, time.perf_counter() - t0
+
+    print(f"writing {args.n} files of {args.size}B with concurrency {args.c} ...")
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.c) as pool:
+        for fid, dt in pool.map(one_write, range(args.n)):
+            fids.append(fid)
+            latencies.append(dt)
+    wall = time.perf_counter() - t0
+    _report("write", args, latencies, wall)
+
+    def one_read(fid):
+        t0 = time.perf_counter()
+        data = operation.download(args.master, fid)
+        assert len(data) == args.size
+        return time.perf_counter() - t0
+
+    latencies = []
+    print(f"reading {len(fids)} files ...")
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.c) as pool:
+        latencies = list(pool.map(one_read, fids))
+    wall = time.perf_counter() - t0
+    _report("read", args, latencies, wall)
+
+
+def _report(op, args, latencies, wall):
+    import numpy as np
+
+    lat = np.array(sorted(latencies))
+    total = len(lat)
+    print(f"\n--- {op} ---")
+    print(f"requests/sec: {total / wall:,.2f}")
+    print(f"transfer/sec: {total * args.size / wall / 1e6:,.2f} MB/s")
+    for p in (50, 90, 99):
+        print(f"p{p} latency: {lat[int(total * p / 100) - 1] * 1000:.2f} ms")
+    print(f"max latency: {lat[-1] * 1000:.2f} ms")
+
+
+def cmd_shell(args):
+    from .shell.shell import run_shell
+
+    run_shell(args.master)
+
+
+def cmd_version(args):
+    from . import __version__
+
+    print(f"seaweedfs_tpu {__version__}")
+
+
+def _wait_forever():
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="seaweedfs_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("master", help="run a master server")
+    m.add_argument("-ip", default="127.0.0.1")
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-volumeSizeLimitMB", dest="volume_size_limit_mb", type=int, default=30 * 1024)
+    m.add_argument("-defaultReplication", dest="default_replication", default="000")
+    m.set_defaults(fn=cmd_master)
+
+    v = sub.add_parser("volume", help="run a volume server")
+    v.add_argument("-ip", default="127.0.0.1")
+    v.add_argument("-port", type=int, default=8080)
+    v.add_argument("-dir", default="./data")
+    v.add_argument("-mserver", default="127.0.0.1:9333")
+    v.add_argument("-dataCenter", dest="data_center", default="DefaultDataCenter")
+    v.add_argument("-rack", default="DefaultRack")
+    v.add_argument("-max", type=int, default=7)
+    v.add_argument("-ec.backend", dest="ec_backend", default="", choices=["", "tpu", "cpu", "numpy"])
+    v.set_defaults(fn=cmd_volume)
+
+    s = sub.add_parser("server", help="master + volume in one process")
+    s.add_argument("-ip", default="127.0.0.1")
+    s.add_argument("-master.port", dest="master_port", type=int, default=9333)
+    s.add_argument("-port", type=int, default=8080)
+    s.add_argument("-dir", default="./data")
+    s.add_argument("-max", type=int, default=7)
+    s.add_argument("-ec.backend", dest="ec_backend", default="")
+    s.set_defaults(fn=cmd_server)
+
+    u = sub.add_parser("upload", help="upload files")
+    u.add_argument("-master", default="127.0.0.1:9333")
+    u.add_argument("-replication", default="")
+    u.add_argument("-collection", default="")
+    u.add_argument("-ttl", default="")
+    u.add_argument("files", nargs="+")
+    u.set_defaults(fn=cmd_upload)
+
+    d = sub.add_parser("download", help="download by fid")
+    d.add_argument("-master", default="127.0.0.1:9333")
+    d.add_argument("-o", dest="output", default="-")
+    d.add_argument("fid")
+    d.set_defaults(fn=cmd_download)
+
+    de = sub.add_parser("delete", help="delete fids")
+    de.add_argument("-master", default="127.0.0.1:9333")
+    de.add_argument("fids", nargs="+")
+    de.set_defaults(fn=cmd_delete)
+
+    e = sub.add_parser("ec.encode", help="erasure-code a volume")
+    e.add_argument("-master", default="127.0.0.1:9333")
+    e.add_argument("-volume", type=int, required=True)
+    e.set_defaults(fn=cmd_ec_encode)
+
+    b = sub.add_parser("benchmark", help="write/read benchmark")
+    b.add_argument("-master", default="127.0.0.1:9333")
+    b.add_argument("-c", type=int, default=16)
+    b.add_argument("-n", type=int, default=10000)
+    b.add_argument("-size", type=int, default=1024)
+    b.add_argument("-collection", default="benchmark")
+    b.set_defaults(fn=cmd_benchmark)
+
+    sh = sub.add_parser("shell", help="admin shell")
+    sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.set_defaults(fn=cmd_shell)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -81,7 +81,16 @@ class Master:
 
     def handle_heartbeat(self, dn: DataNode, hb: dict) -> dict:
         """Full or delta heartbeat dict (Store.collect_heartbeat shape).
-        Returns the ack (volume size limit + leader)."""
+        Returns the ack (volume size limit + leader).
+
+        Holds the master lock: a full sync racing a concurrent assign/grow
+        (which registers new volumes under the same lock) must not replace
+        the node's volume list with a pre-grow snapshot and unregister a
+        volume whose fid was just handed out."""
+        with self._lock:
+            return self._handle_heartbeat_locked(dn, hb)
+
+    def _handle_heartbeat_locked(self, dn: DataNode, hb: dict) -> dict:
         dn.last_seen = time.time()
         if "max_file_key" in hb:
             self.sequencer.set_max(hb["max_file_key"])
@@ -255,7 +264,13 @@ class Master:
                 with layout._lock:
                     layout._remove_from_writable(vid)
                 try:
-                    if all(compact(dn, vid) for dn in list(locations)):
+                    ok = True
+                    for dn in list(locations):
+                        try:
+                            ok = compact(dn, vid) and ok
+                        except Exception:
+                            ok = False  # unreachable replica: skip, keep scanning
+                    if ok:
                         compacted.append(vid)
                 finally:
                     with layout._lock:

@@ -1,0 +1,147 @@
+"""Client operations library: assign / upload / lookup / delete / submit.
+
+Mirrors `weed/operation/` (assign_file_id.go:36, upload_content.go:68,
+lookup.go, delete_content.go:32, submit.go:41): the primitives every gateway
+and CLI tool builds on, over the master + volume server HTTP surfaces.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .server.http_util import http_bytes, http_json
+from .storage.file_id import FileId
+
+
+@dataclass
+class Assignment:
+    fid: str
+    url: str
+    public_url: str
+    count: int
+    replicas: list[str] = field(default_factory=list)
+
+
+def assign(
+    master: str,
+    count: int = 1,
+    replication: str = "",
+    collection: str = "",
+    ttl: str = "",
+    data_center: str = "",
+) -> Assignment:
+    q = f"count={count}&replication={replication}&collection={collection}&ttl={ttl}&dataCenter={data_center}"
+    r = http_json("POST", f"http://{master}/dir/assign?{q}")
+    if r.get("error"):
+        raise RuntimeError(f"assign: {r['error']}")
+    return Assignment(
+        fid=r["fid"],
+        url=r["url"],
+        public_url=r.get("publicUrl", r["url"]),
+        count=r.get("count", count),
+        replicas=r.get("replicas", []),
+    )
+
+
+def upload_data(
+    url: str,
+    fid: str,
+    data: bytes,
+    name: str = "",
+    mime: str = "",
+    ttl: str = "",
+) -> dict:
+    import urllib.request
+
+    q = f"?ttl={ttl}" if ttl else ""
+    req = urllib.request.Request(
+        f"http://{url}/{fid}{q}", data=data, method="POST"
+    )
+    if name:
+        req.add_header("X-Sweed-Name", name)
+    if mime:
+        req.add_header("X-Sweed-Mime", mime)
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        import json
+
+        return json.loads(resp.read() or b"{}")
+
+
+class LookupCache:
+    """vid → locations with TTL (operation/lookup.go cache)."""
+
+    def __init__(self, master: str, ttl_seconds: float = 600.0):
+        self.master = master
+        self.ttl = ttl_seconds
+        self._cache: dict[int, tuple[float, list[dict]]] = {}
+
+    def lookup(self, vid: int) -> list[dict]:
+        now = time.time()
+        hit = self._cache.get(vid)
+        if hit and now - hit[0] < self.ttl:
+            return hit[1]
+        r = http_json("GET", f"http://{self.master}/dir/lookup?volumeId={vid}")
+        locs = r.get("locations", [])
+        if locs:
+            self._cache[vid] = (now, locs)
+        return locs
+
+    def invalidate(self, vid: int) -> None:
+        self._cache.pop(vid, None)
+
+
+def lookup(master: str, vid: int) -> list[dict]:
+    r = http_json("GET", f"http://{master}/dir/lookup?volumeId={vid}")
+    return r.get("locations", [])
+
+
+def download(master: str, fid: str) -> bytes:
+    file_id = FileId.parse(fid)
+    locs = lookup(master, file_id.volume_id)
+    if not locs:
+        raise RuntimeError(f"volume {file_id.volume_id} not found")
+    last_err = None
+    for loc in locs:
+        status, data = http_bytes("GET", f"http://{loc['url']}/{fid}")
+        if status == 200:
+            return data
+        last_err = f"{loc['url']}: {status}"
+    raise RuntimeError(f"download {fid}: {last_err}")
+
+
+def delete_file(master: str, fid: str) -> bool:
+    file_id = FileId.parse(fid)
+    locs = lookup(master, file_id.volume_id)
+    for loc in locs:
+        status, _ = http_bytes("DELETE", f"http://{loc['url']}/{fid}")
+        if status < 300:
+            return True
+    return False
+
+
+def delete_files(master: str, fids: list[str]) -> int:
+    """Grouped deletion (delete_content.go:32); count of deleted files."""
+    ok = 0
+    for fid in fids:  # volume-grouping optimization comes with gRPC batching
+        if delete_file(master, fid):
+            ok += 1
+    return ok
+
+
+def submit(
+    master: str,
+    data: bytes,
+    name: str = "",
+    mime: str = "",
+    replication: str = "",
+    collection: str = "",
+    ttl: str = "",
+) -> str:
+    """Assign + upload in one call (submit.go:41). Returns the fid."""
+    a = assign(
+        master, replication=replication, collection=collection, ttl=ttl
+    )
+    upload_data(a.url, a.fid, data, name=name, mime=mime, ttl=ttl)
+    return a.fid

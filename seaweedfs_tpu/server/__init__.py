@@ -1,0 +1,10 @@
+"""Daemons: master server + volume server over threaded HTTP.
+
+Transport note: the reference exposes assign/lookup and the whole data plane
+over HTTP+JSON (`weed/server/master_server_handlers.go`,
+`volume_server_handlers_*.go`) and uses gRPC streams for heartbeat/admin
+(`pb/master.proto`, `pb/volume_server.proto`). Here every surface is HTTP:
+the heartbeat stream becomes a periodic POST (same reconciliation semantics,
+delta beats included), and the admin RPCs are POST endpoints mirroring the
+gRPC method names so the parity mapping stays 1:1.
+"""

@@ -1,0 +1,110 @@
+"""Tiny HTTP helpers shared by the daemons (stdlib-only)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Route table based handler; subclasses set `routes` as
+    [(method, path_prefix, fn)] where fn(handler, path, query, body) →
+    (status, payload). Payload bytes pass through; anything else is JSON."""
+
+    protocol_version = "HTTP/1.1"
+    routes: list[tuple[str, str, Callable]] = []
+    server_ctx: Any = None
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        for m, prefix, fn in self.routes:
+            if m == method and parsed.path.startswith(prefix):
+                try:
+                    status, payload = fn(self, parsed.path, query, body)
+                except Exception as e:
+                    status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+                self._reply(status, payload, head_only=(method == "HEAD"))
+                return
+        self._reply(404, {"error": f"no route {method} {parsed.path}"})
+
+    def _reply(self, status: int, payload, head_only: bool = False) -> None:
+        if isinstance(payload, (bytes, bytearray)):
+            data = bytes(payload)
+            ctype = "application/octet-stream"
+        else:
+            data = json.dumps(payload).encode()
+            ctype = "application/json"
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        if not head_only:  # HEAD: headers only, or keep-alive framing breaks
+            self.wfile.write(data)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    def do_PUT(self):
+        self._dispatch("PUT")
+
+    def do_HEAD(self):
+        self._dispatch("HEAD")
+
+
+def start_server(handler_cls, host: str, port: int) -> ThreadingHTTPServer:
+    srv = ThreadingHTTPServer((host, port), handler_cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+def http_json(
+    method: str,
+    url: str,
+    body: Optional[dict | bytes] = None,
+    timeout: float = 30.0,
+) -> dict:
+    data = None
+    headers = {}
+    if body is not None:
+        if isinstance(body, dict):
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        else:
+            data = body
+    req = urllib.request.Request(url, data=data, method=method, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read() or b"{}") | {"_status": e.code}
+        except json.JSONDecodeError:
+            return {"error": str(e), "_status": e.code}
+
+
+def http_bytes(
+    method: str, url: str, body: Optional[bytes] = None, timeout: float = 30.0
+) -> tuple[int, bytes]:
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()

@@ -1,0 +1,227 @@
+"""Master daemon: HTTP surface over the cluster core.
+
+Endpoint map (reference handler → here):
+    /dir/assign        master_server_handlers.go:96  → GET/POST /dir/assign
+    /dir/lookup        master_server_handlers.go:32  → GET /dir/lookup
+    /vol/grow          master_server_handlers_admin  → POST /vol/grow
+    /vol/vacuum        master_server_handlers_admin  → POST /vol/vacuum
+    /col/delete        collection handlers           → POST /col/delete
+    SendHeartbeat rpc  master_grpc_server.go:20      → POST /cluster/heartbeat
+    LookupEcVolume rpc master_grpc_server_volume.go  → GET /dir/lookup_ec
+    LeaseAdminToken    master_grpc_server_admin.go   → POST /cluster/lock
+    /dir/status, /cluster/status                     → GET (topology json)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..cluster.master import Master
+from ..cluster.topology import DataNode
+from .http_util import JsonHandler, http_json, start_server
+
+
+class MasterServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 9333,
+        volume_size_limit_mb: int = 30 * 1024,
+        default_replication: str = "000",
+        garbage_threshold: float = 0.3,
+        node_timeout: float = 15.0,
+    ):
+        self.host, self.port = host, port
+        self.master = Master(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            default_replication=default_replication,
+            allocate_volume=self._allocate_volume,
+            garbage_threshold=garbage_threshold,
+        )
+        self.node_timeout = node_timeout
+        self._nodes: dict[str, DataNode] = {}
+        self._lock = threading.Lock()
+        self._srv = None
+        self._reaper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- volume allocation via volume server admin endpoint ------------------
+    def _allocate_volume(self, dn: DataNode, vid: int, option) -> None:
+        r = http_json(
+            "POST",
+            f"http://{dn.url()}/admin/assign_volume?volume={vid}"
+            f"&collection={option.collection}&replication={option.replica_placement}"
+            f"&ttl={option.ttl}",
+        )
+        if r.get("error"):
+            raise RuntimeError(f"allocate volume {vid} on {dn.url()}: {r['error']}")
+
+    # -- handlers ------------------------------------------------------------
+    def _h_assign(self, h, path, q, body):
+        res = self.master.assign(
+            count=int(q.get("count", 1)),
+            replication=q.get("replication", ""),
+            collection=q.get("collection", ""),
+            ttl=q.get("ttl", ""),
+            data_center=q.get("dataCenter", ""),
+        )
+        return 200, {
+            "fid": res.fid,
+            "url": res.url,
+            "publicUrl": res.public_url,
+            "count": res.count,
+            "replicas": res.replicas,
+        }
+
+    def _h_lookup(self, h, path, q, body):
+        vid_str = q.get("volumeId", "")
+        if "," in vid_str:
+            vid_str = vid_str.split(",")[0]
+        locations = self.master.lookup_volume(int(vid_str), q.get("collection", ""))
+        if not locations:
+            return 404, {"volumeId": vid_str, "error": "volume id not found"}
+        return 200, {"volumeId": vid_str, "locations": locations}
+
+    def _h_lookup_ec(self, h, path, q, body):
+        vid = int(q.get("volumeId", "0"))
+        res = self.master.lookup_ec_volume(vid)
+        if not res["shard_id_locations"]:
+            return 404, {"error": f"ec volume {vid} not found"}
+        return 200, res
+
+    def _h_heartbeat(self, h, path, q, body):
+        import json
+
+        hb = json.loads(body)
+        url = f"{hb['ip']}:{hb['port']}"
+        # registration AND heartbeat application under one lock so the
+        # reaper can't disconnect the node between the two (an orphaned
+        # DataNode re-registered here would leak stale locations forever)
+        with self._lock:
+            dn = self._nodes.get(url)
+            if dn is None:
+                dn = self.master.register_data_node(
+                    hb["ip"],
+                    hb["port"],
+                    public_url=hb.get("public_url", ""),
+                    data_center=hb.get("data_center", "DefaultDataCenter"),
+                    rack=hb.get("rack", "DefaultRack"),
+                    max_volume_count=hb.get("max_volume_count", 7),
+                )
+                self._nodes[url] = dn
+            ack = self.master.handle_heartbeat(dn, hb)
+        return 200, ack
+
+    def _h_grow(self, h, path, q, body):
+        from ..cluster.volume_growth import VolumeGrowOption
+        from ..storage.replica_placement import ReplicaPlacement
+        from ..storage.ttl import EMPTY_TTL, read_ttl
+
+        rp = ReplicaPlacement.from_string(
+            q.get("replication", str(self.master.default_replication))
+        )
+        option = VolumeGrowOption(
+            collection=q.get("collection", ""),
+            replica_placement=rp,
+            ttl=read_ttl(q["ttl"]) if q.get("ttl") else EMPTY_TTL,
+            data_center=q.get("dataCenter", ""),
+        )
+        count = int(q.get("count", 1))
+        grown = self.master.vg.grow_by_count(self.master.topo, option, count)
+        return 200, {"count": grown}
+
+    def _h_vacuum(self, h, path, q, body):
+        threshold = float(q.get("garbageThreshold", self.master.garbage_threshold))
+
+        def check(dn, vid):
+            r = http_json("GET", f"http://{dn.url()}/admin/vacuum_check?volume={vid}")
+            return float(r.get("garbage_ratio", 0.0))
+
+        def compact(dn, vid):
+            r = http_json("POST", f"http://{dn.url()}/admin/vacuum?volume={vid}")
+            return not r.get("error")
+
+        compacted = self.master.vacuum(check, compact, threshold)
+        return 200, {"compacted": compacted}
+
+    def _h_col_delete(self, h, path, q, body):
+        name = q.get("collection", "")
+        vids = self.master.collection_delete(name)
+        # propagate deletion to the volume servers holding those volumes
+        for url, dn in list(self._nodes.items()):
+            for vid in vids:
+                if vid in dn.volumes:
+                    http_json("POST", f"http://{url}/admin/delete_volume?volume={vid}")
+        return 200, {"collection": name, "volumes": vids}
+
+    def _h_status(self, h, path, q, body):
+        return 200, {
+            "version": "seaweedfs_tpu 0.1",
+            "topology": self.master.topology_info(),
+        }
+
+    def _h_lock(self, h, path, q, body):
+        try:
+            token = self.master.lease_admin_token(
+                q.get("client", "shell"), q.get("previous") or None
+            )
+            return 200, {"token": token}
+        except RuntimeError as e:
+            return 409, {"error": str(e)}
+
+    def _h_unlock(self, h, path, q, body):
+        self.master.release_admin_token(q.get("token", ""))
+        return 200, {}
+
+    def _h_collections(self, h, path, q, body):
+        return 200, {"collections": self.master.collection_list()}
+
+    # -- liveness reaping (master_grpc_server.go:22-50 on stream close) ------
+    def _reap_loop(self):
+        while not self._stop.wait(self.node_timeout / 3):
+            now = time.time()
+            with self._lock:
+                for url, dn in list(self._nodes.items()):
+                    if now - dn.last_seen > self.node_timeout:
+                        self.master.handle_node_disconnect(dn)
+                        del self._nodes[url]
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        ms = self
+
+        class Handler(JsonHandler):
+            routes = [
+                ("GET", "/dir/assign", ms._h_assign),
+                ("POST", "/dir/assign", ms._h_assign),
+                ("GET", "/dir/lookup_ec", ms._h_lookup_ec),
+                ("GET", "/dir/lookup", ms._h_lookup),
+                ("POST", "/cluster/heartbeat", ms._h_heartbeat),
+                ("POST", "/vol/grow", ms._h_grow),
+                ("GET", "/vol/grow", ms._h_grow),
+                ("POST", "/vol/vacuum", ms._h_vacuum),
+                ("GET", "/vol/vacuum", ms._h_vacuum),
+                ("POST", "/col/delete", ms._h_col_delete),
+                ("GET", "/col/list", ms._h_collections),
+                ("POST", "/cluster/lock", ms._h_lock),
+                ("POST", "/cluster/unlock", ms._h_unlock),
+                ("GET", "/dir/status", ms._h_status),
+                ("GET", "/cluster/status", ms._h_status),
+            ]
+
+        self._srv = start_server(Handler, self.host, self.port)
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+        self._reaper.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._srv:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"

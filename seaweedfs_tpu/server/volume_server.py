@@ -1,0 +1,457 @@
+"""Volume server daemon: needle data plane + admin surface + heartbeats.
+
+Endpoint map (reference → here):
+    GET/HEAD /<vid>,<fid>      volume_server_handlers_read.go:28
+    POST     /<vid>,<fid>      volume_server_handlers_write.go:19 (raw body;
+                               name/mime via X-Sweed-Name/X-Sweed-Mime —
+                               deviation: multipart is optional, not required)
+    DELETE   /<vid>,<fid>      volume_server_handlers_write.go:78
+    replicated writes          topology/store_replicate.go:21 → the primary
+                               fans out `?type=replicate` to sister replicas
+    AllocateVolume rpc         → POST /admin/assign_volume
+    VacuumVolume* rpcs         → GET /admin/vacuum_check, POST /admin/vacuum
+    DeleteCollection/Volume    → POST /admin/delete_volume
+    VolumeMarkReadonly rpc     → POST /admin/readonly
+    VolumeEcShardsGenerate     → POST /admin/ec/generate   (TPU codec here)
+    VolumeEcShardsRebuild      → POST /admin/ec/rebuild
+    VolumeEcShardsCopy         → POST /admin/ec/copy (pull from source url)
+    VolumeEcShardRead rpc      → GET /admin/ec/shard_read (binary)
+    VolumeEcShardsMount/Unmount→ POST /admin/ec/mount, /admin/ec/unmount
+    CopyFile rpc               → GET /admin/file?name=<base.ext> (binary)
+    /status                    → GET /status
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from ..ec import encoder
+from ..ec.constants import TOTAL_SHARDS, shard_ext
+from ..ec.ec_volume import EcVolume
+from ..storage.file_id import parse_needle_id_cookie
+from ..storage.needle import (
+    FLAG_HAS_LAST_MODIFIED,
+    FLAG_HAS_MIME,
+    FLAG_HAS_NAME,
+    Needle,
+)
+from ..storage.store import Store
+from ..storage.volume import DeletedError, NotFoundError, volume_file_name
+from .http_util import JsonHandler, http_bytes, http_json, start_server
+
+
+class VolumeServer:
+    def __init__(
+        self,
+        directories: list[str],
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        master_url: str = "127.0.0.1:9333",
+        public_url: str = "",
+        data_center: str = "DefaultDataCenter",
+        rack: str = "DefaultRack",
+        max_volume_count: int = 7,
+        pulse_seconds: float = 5.0,
+        ec_backend: Optional[str] = None,
+    ):
+        self.host, self.port = host, port
+        self.master_url = master_url
+        self.data_center, self.rack = data_center, rack
+        self.max_volume_count = max_volume_count
+        self.pulse_seconds = pulse_seconds
+        self.store = Store(
+            directories,
+            ip=host,
+            port=port,
+            public_url=public_url or f"{host}:{port}",
+            ec_backend=ec_backend,
+        )
+        self.store.remote_shard_reader = self._remote_shard_reader
+        self._srv = None
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # -- remote EC shard read via master shard lookup ------------------------
+    def _remote_shard_reader(self, vid, shard_id, offset, size):
+        r = http_json(
+            "GET", f"http://{self.master_url}/dir/lookup_ec?volumeId={vid}"
+        )
+        holders = r.get("shard_id_locations", {}).get(str(shard_id)) or r.get(
+            "shard_id_locations", {}
+        ).get(shard_id, [])
+        me = f"{self.host}:{self.port}"
+        for holder in holders:
+            if holder == me:
+                continue
+            status, data = http_bytes(
+                "GET",
+                f"http://{holder}/admin/ec/shard_read?volume={vid}"
+                f"&shard={shard_id}&offset={offset}&size={size}",
+            )
+            if status == 200 and len(data) == size:
+                return data
+        return None
+
+    # -- data plane ----------------------------------------------------------
+    def _parse_fid_path(self, path: str):
+        # /3,01637037d6 or /3/01637037d6[.ext]
+        p = path.lstrip("/")
+        if "," in p:
+            vid_str, fid = p.split(",", 1)
+        elif "/" in p:
+            vid_str, fid = p.split("/", 1)
+        else:
+            raise ValueError(f"bad fid path {path!r}")
+        if "." in fid:
+            fid = fid[: fid.rindex(".")]
+        nid, cookie = parse_needle_id_cookie(fid)
+        return int(vid_str), nid, cookie
+
+    def _h_get(self, h, path, q, body):
+        vid, nid, cookie = self._parse_fid_path(path)
+        n = Needle(id=nid)
+        try:
+            self.store.read_volume_needle(vid, n)
+        except (NotFoundError, Exception) as e:
+            if isinstance(e, (NotFoundError, DeletedError)) or "not in ecx" in str(e):
+                return 404, {"error": str(e)}
+            raise
+        if n.cookie != cookie:
+            return 404, {"error": "cookie mismatch"}
+        return 200, bytes(n.data)
+
+    def _h_post(self, h, path, q, body):
+        vid, nid, cookie = self._parse_fid_path(path)
+        n = Needle(cookie=cookie, id=nid, data=bytes(body))
+        name = h.headers.get("X-Sweed-Name")
+        mime = h.headers.get("X-Sweed-Mime")
+        if name:
+            n.name = name.encode()[:255]
+            n.set_flag(FLAG_HAS_NAME)
+        if mime:
+            n.mime = mime.encode()[:255]
+            n.set_flag(FLAG_HAS_MIME)
+        import time as _time
+
+        n.last_modified = int(_time.time())
+        n.set_flag(FLAG_HAS_LAST_MODIFIED)
+        if q.get("ttl"):
+            from ..storage.needle import FLAG_HAS_TTL
+            from ..storage.ttl import read_ttl
+
+            n.ttl = read_ttl(q["ttl"])
+            n.set_flag(FLAG_HAS_TTL)
+        _, size, unchanged = self.store.write_volume_needle(
+            vid, n, fsync=q.get("fsync") == "true"
+        )
+        if q.get("type") != "replicate":
+            err = self._replicate(path, q, body, h, "POST")
+            if err:
+                # strict all-replicas-or-fail (store_replicate.go:21)
+                n2 = Needle(cookie=cookie, id=nid)
+                self.store.delete_volume_needle(vid, n2)
+                return 500, {"error": f"replication failed: {err}"}
+        return 201, {"size": len(body), "eTag": n.etag(), "unchanged": unchanged}
+
+    def _h_delete(self, h, path, q, body):
+        vid, nid, cookie = self._parse_fid_path(path)
+        n = Needle(cookie=cookie, id=nid)
+        size = self.store.delete_volume_needle(vid, n)
+        if q.get("type") != "replicate":
+            err = self._replicate(path, q, b"", h, "DELETE")
+            if err:
+                return 500, {"error": f"replicated delete failed: {err}"}
+        return 202, {"size": size}
+
+    def _replicate(self, path, q, body, h, method) -> Optional[str]:
+        """Fan out to sister replicas (distributedOperation,
+        store_replicate.go:95)."""
+        vid = int(path.lstrip("/").split(",")[0].split("/")[0])
+        r = http_json("GET", f"http://{self.master_url}/dir/lookup?volumeId={vid}")
+        me = self.store.public_url
+        errors = []
+        for loc in r.get("locations", []):
+            url = loc["url"]
+            if url == me or url == f"{self.host}:{self.port}":
+                continue
+            extra = "&".join(
+                f"{k}={v}" for k, v in q.items() if k not in ("type",)
+            )
+            full = f"http://{url}{path}?type=replicate" + (
+                f"&{extra}" if extra else ""
+            )
+            status, resp = http_bytes(method, full, body if method == "POST" else None)
+            if status >= 300:
+                errors.append(f"{url}: {status} {resp[:100]!r}")
+        return "; ".join(errors) if errors else None
+
+    # -- admin: volumes ------------------------------------------------------
+    def _h_assign_volume(self, h, path, q, body):
+        vid = int(q["volume"])
+        self.store.add_volume(
+            vid,
+            collection=q.get("collection", ""),
+            replica_placement=q.get("replication") or "000",
+            ttl=q.get("ttl", ""),
+        )
+        return 200, {}
+
+    def _h_delete_volume(self, h, path, q, body):
+        ok = self.store.delete_volume(int(q["volume"]))
+        return 200, {"deleted": ok}
+
+    def _h_readonly(self, h, path, q, body):
+        ok = self.store.mark_volume_readonly(int(q["volume"]))
+        return (200, {}) if ok else (404, {"error": "volume not found"})
+
+    def _h_vacuum_check(self, h, path, q, body):
+        v = self.store.find_volume(int(q["volume"]))
+        if v is None:
+            return 404, {"error": "volume not found"}
+        return 200, {"garbage_ratio": v.garbage_level()}
+
+    def _h_vacuum(self, h, path, q, body):
+        v = self.store.find_volume(int(q["volume"]))
+        if v is None:
+            return 404, {"error": "volume not found"}
+        v.compact()
+        return 200, {"size": v.size()}
+
+    # -- admin: EC (volume_grpc_erasure_coding.go) ---------------------------
+    def _find_base(self, vid: int) -> Optional[str]:
+        v = self.store.find_volume(vid)
+        if v is not None:
+            return v.file_name()
+        for loc in self.store.locations:
+            for name in os.listdir(loc.directory):
+                if name.endswith(".ecx"):
+                    from ..storage.disk_location import parse_volume_base_name
+
+                    try:
+                        col, v_id = parse_volume_base_name(name[:-4])
+                    except ValueError:
+                        continue
+                    if v_id == vid:
+                        return os.path.join(loc.directory, name[:-4])
+        return None
+
+    def _h_ec_generate(self, h, path, q, body):
+        """VolumeEcShardsGenerate (volume_grpc_erasure_coding.go:39): mark
+        readonly, stripe to 14 shards with the TPU/CPU codec, write .ecx/.vif."""
+        vid = int(q["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": "volume not found"}
+        v.read_only = True
+        v.sync()
+        base = v.file_name()
+        encoder.write_ec_files(base, self.store.ec_codec)
+        encoder.write_sorted_file_from_idx(base)
+        encoder.save_volume_info(
+            base + ".vif",
+            version=v.version,
+            replication=str(v.super_block.replica_placement),
+        )
+        return 200, {"shards": list(range(TOTAL_SHARDS))}
+
+    def _h_ec_rebuild(self, h, path, q, body):
+        vid = int(q["volume"])
+        base = self._find_base(vid)
+        if base is None:
+            return 404, {"error": "ec volume not found"}
+        generated = encoder.rebuild_ec_files(base, self.store.ec_codec)
+        from ..ec.ec_volume import rebuild_ecx_file
+
+        rebuild_ecx_file(base)
+        return 200, {"rebuilt_shards": generated}
+
+    def _h_ec_copy(self, h, path, q, body):
+        """Pull shard files (and optionally .ecx/.vif) from a source server
+        (VolumeEcShardsCopy, :104)."""
+        vid = int(q["volume"])
+        source = q["source"]
+        shard_ids = [int(s) for s in q.get("shards", "").split(",") if s != ""]
+        collection = q.get("collection", "")
+        loc = self.store.locations[0]
+        base = volume_file_name(loc.directory, collection, vid)
+        copied = []
+        exts = [shard_ext(s) for s in shard_ids]
+        if q.get("copy_ecx", "true") == "true":
+            exts += [".ecx"]
+        if q.get("copy_vif", "true") == "true":
+            exts += [".vif"]
+        for ext in exts:
+            status, data = http_bytes(
+                "GET",
+                f"http://{source}/admin/file?volume={vid}&collection={collection}&ext={ext}",
+            )
+            if status != 200:
+                if ext in (".vif",):
+                    continue
+                return 500, {"error": f"fetch {ext} from {source}: {status}"}
+            with open(base + ext, "wb") as f:
+                f.write(data)
+            copied.append(ext)
+        return 200, {"copied": copied}
+
+    def _h_file(self, h, path, q, body):
+        """Serve a raw volume/shard file (CopyFile rpc)."""
+        vid = int(q["volume"])
+        collection = q.get("collection", "")
+        ext = q["ext"]
+        for loc in self.store.locations:
+            p = volume_file_name(loc.directory, collection, vid) + ext
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    return 200, f.read()
+        return 404, {"error": f"{vid}{ext} not found"}
+
+    def _h_volume_copy(self, h, path, q, body):
+        """Pull a whole volume (.dat/.idx) from a source server and load it
+        (VolumeCopy rpc, volume_grpc_copy.go)."""
+        vid = int(q["volume"])
+        source = q["source"]
+        collection = q.get("collection", "")
+        if self.store.find_volume(vid) is not None:
+            return 409, {"error": f"volume {vid} already here"}
+        loc = self.store.locations[0]
+        base = volume_file_name(loc.directory, collection, vid)
+        for ext in (".dat", ".idx"):
+            status, data = http_bytes(
+                "GET",
+                f"http://{source}/admin/file?volume={vid}&collection={collection}&ext={ext}",
+            )
+            if status != 200:
+                return 500, {"error": f"fetch {ext}: {status}"}
+            with open(base + ext, "wb") as f:
+                f.write(data)
+        loc.load_existing_volumes()
+        if self.store.find_volume(vid) is None:
+            return 500, {"error": "volume copied but failed to load"}
+        self.store.new_volumes.append(vid)
+        try:
+            self._heartbeat_once()
+        except Exception:
+            pass
+        return 200, {}
+
+    def _h_ec_mount(self, h, path, q, body):
+        vid = int(q["volume"])
+        for loc in self.store.locations:
+            loc.load_existing_volumes()
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            return 404, {"error": f"no local shards for {vid}"}
+        ev.refresh_shards()
+        return 200, {"shards": ev.shard_ids()}
+
+    def _h_ec_unmount(self, h, path, q, body):
+        vid = int(q["volume"])
+        for loc in self.store.locations:
+            loc.unload_ec_volume(vid)
+        return 200, {}
+
+    def _h_ec_delete_shards(self, h, path, q, body):
+        vid = int(q["volume"])
+        shard_ids = [int(s) for s in q.get("shards", "").split(",") if s != ""]
+        base = self._find_base(vid)
+        removed = []
+        if base:
+            for sid in shard_ids:
+                try:
+                    os.remove(base + shard_ext(sid))
+                    removed.append(sid)
+                except FileNotFoundError:
+                    pass
+        for loc in self.store.locations:
+            ev = loc.find_ec_volume(vid)
+            if ev:
+                for sid in shard_ids:
+                    shard = ev.shards.pop(sid, None)
+                    if shard:
+                        shard.close()
+        return 200, {"removed": removed}
+
+    def _h_ec_shard_read(self, h, path, q, body):
+        vid = int(q["volume"])
+        sid = int(q["shard"])
+        offset, size = int(q["offset"]), int(q["size"])
+        ev = self.store.find_ec_volume(vid)
+        if ev is None or sid not in ev.shards:
+            return 404, {"error": f"shard {vid}.{sid} not here"}
+        return 200, ev.shards[sid].read_at(offset, size)
+
+    def _h_status(self, h, path, q, body):
+        hb = self.store.collect_heartbeat()
+        hb["ec"] = self.store.collect_ec_heartbeat()["ec_shards"]
+        return 200, hb
+
+    # -- heartbeat loop (volume_grpc_client_to_master.go:50) -----------------
+    def _heartbeat_once(self) -> None:
+        hb = self.store.collect_heartbeat()
+        hb["ec_shards"] = self.store.collect_ec_heartbeat()["ec_shards"]
+        # full beats supersede the delta queues (the reference's Store delta
+        # channels feed instant beats between pulses); drain so they don't
+        # grow unboundedly — instant delta beats are a future optimization
+        self.store.new_volumes.clear()
+        self.store.deleted_volumes.clear()
+        hb["data_center"] = self.data_center
+        hb["rack"] = self.rack
+        hb["max_volume_count"] = self.max_volume_count
+        http_json(
+            "POST", f"http://{self.master_url}/cluster/heartbeat", hb, timeout=10
+        )
+
+    def _hb_loop(self):
+        while not self._stop.wait(self.pulse_seconds):
+            try:
+                self._heartbeat_once()
+            except Exception:
+                pass  # master down: keep trying (failover comes with HA)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        vs = self
+
+        class Handler(JsonHandler):
+            routes = [
+                ("POST", "/admin/assign_volume", vs._h_assign_volume),
+                ("POST", "/admin/delete_volume", vs._h_delete_volume),
+                ("POST", "/admin/readonly", vs._h_readonly),
+                ("GET", "/admin/vacuum_check", vs._h_vacuum_check),
+                ("POST", "/admin/vacuum", vs._h_vacuum),
+                ("POST", "/admin/volume_copy", vs._h_volume_copy),
+                ("POST", "/admin/ec/generate", vs._h_ec_generate),
+                ("POST", "/admin/ec/rebuild", vs._h_ec_rebuild),
+                ("POST", "/admin/ec/copy", vs._h_ec_copy),
+                ("GET", "/admin/ec/shard_read", vs._h_ec_shard_read),
+                ("POST", "/admin/ec/mount", vs._h_ec_mount),
+                ("POST", "/admin/ec/unmount", vs._h_ec_unmount),
+                ("POST", "/admin/ec/delete_shards", vs._h_ec_delete_shards),
+                ("GET", "/admin/file", vs._h_file),
+                ("GET", "/status", vs._h_status),
+                ("GET", "/", vs._h_get),
+                ("HEAD", "/", vs._h_get),
+                ("POST", "/", vs._h_post),
+                ("PUT", "/", vs._h_post),
+                ("DELETE", "/", vs._h_delete),
+            ]
+
+        self._srv = start_server(Handler, self.host, self.port)
+        try:
+            self._heartbeat_once()
+        except Exception:
+            pass
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._srv:
+            self._srv.shutdown()
+            self._srv.server_close()
+        self.store.close()

@@ -1,0 +1,1 @@
+"""Admin shell (reference: `weed shell`, weed/shell/)."""

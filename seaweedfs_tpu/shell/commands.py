@@ -1,0 +1,323 @@
+"""Admin shell commands over the master/volume HTTP surfaces.
+
+Mirrors the high-value subset of `weed/shell/`:
+    volume.list, volume.vacuum, volume.delete, volume.mark (readonly)
+    ec.encode   (command_ec_encode.go:55 — readonly → generate → spread)
+    ec.rebuild  (command_ec_rebuild.go:57 — copy ≥k shards → rebuild → mount)
+    ec.balance  (command_ec_balance.go — even shard spread across servers)
+    collection.list / collection.delete, cluster.status, lock / unlock
+
+Every command is a plain function usable programmatically; the REPL wraps
+them. The cluster admin lock (LeaseAdminToken) is honored for mutating ops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..ec.constants import TOTAL_SHARDS
+from ..server.http_util import http_json
+
+
+@dataclass
+class CommandEnv:
+    master: str
+    token: Optional[str] = None
+
+    def lock(self) -> str:
+        r = http_json("POST", f"http://{self.master}/cluster/lock?client=shell")
+        if r.get("error"):
+            raise RuntimeError(r["error"])
+        self.token = r["token"]
+        return self.token
+
+    def unlock(self) -> None:
+        if self.token:
+            http_json(
+                "POST", f"http://{self.master}/cluster/unlock?token={self.token}"
+            )
+            self.token = None
+
+    # -- cluster introspection ----------------------------------------------
+    def topology(self) -> dict:
+        return http_json("GET", f"http://{self.master}/dir/status")["topology"]
+
+    def data_nodes(self) -> list[dict]:
+        return [
+            n
+            for dc in self.topology()["data_centers"]
+            for r in dc["racks"]
+            for n in r["nodes"]
+        ]
+
+    def node_status(self, url: str) -> dict:
+        return http_json("GET", f"http://{url}/status")
+
+    def volume_locations(self, vid: int) -> list[str]:
+        r = http_json("GET", f"http://{self.master}/dir/lookup?volumeId={vid}")
+        return [l["url"] for l in r.get("locations", [])]
+
+    def ec_shard_locations(self, vid: int) -> dict[int, list[str]]:
+        r = http_json("GET", f"http://{self.master}/dir/lookup_ec?volumeId={vid}")
+        return {
+            int(sid): urls
+            for sid, urls in r.get("shard_id_locations", {}).items()
+        }
+
+
+# -- informational commands --------------------------------------------------
+def volume_list(env: CommandEnv) -> list[dict]:
+    out = []
+    for n in env.data_nodes():
+        st = env.node_status(n["url"])
+        for v in st.get("volumes", []):
+            out.append({**v, "server": n["url"]})
+    return out
+
+
+def cluster_status(env: CommandEnv) -> dict:
+    return env.topology()
+
+
+def collection_list(env: CommandEnv) -> list[str]:
+    return http_json("GET", f"http://{env.master}/col/list")["collections"]
+
+
+def collection_delete(env: CommandEnv, name: str) -> dict:
+    return http_json("POST", f"http://{env.master}/col/delete?collection={name}")
+
+
+# -- volume commands ----------------------------------------------------------
+def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3) -> list[int]:
+    r = http_json(
+        "POST",
+        f"http://{env.master}/vol/vacuum?garbageThreshold={garbage_threshold}",
+    )
+    return r.get("compacted", [])
+
+
+def volume_delete(env: CommandEnv, vid: int) -> None:
+    for url in env.volume_locations(vid):
+        http_json("POST", f"http://{url}/admin/delete_volume?volume={vid}")
+
+
+def volume_mark_readonly(env: CommandEnv, vid: int) -> None:
+    for url in env.volume_locations(vid):
+        http_json("POST", f"http://{url}/admin/readonly?volume={vid}")
+
+
+# -- EC commands (the north-star workload) ------------------------------------
+def _volume_collection(env: CommandEnv, vid: int) -> str:
+    """Resolve a volume's collection from the servers' status reports."""
+    for v in volume_list(env):
+        if v["id"] == vid:
+            return v.get("collection", "")
+    return ""
+
+
+def ec_encode(
+    env: CommandEnv,
+    vid: int,
+    collection: Optional[str] = None,
+    delete_original: bool = True,
+) -> dict:
+    """command_ec_encode.go:92 doEcEncode: mark readonly → generate 14
+    shards on the source server → spread across servers → register → drop
+    the plain volume."""
+    locations = env.volume_locations(vid)
+    if not locations:
+        raise RuntimeError(f"volume {vid} not found")
+    if collection is None or collection == "":
+        collection = _volume_collection(env, vid)
+    source = locations[0]
+    volume_mark_readonly(env, vid)
+    r = http_json("POST", f"http://{source}/admin/ec/generate?volume={vid}")
+    if r.get("error"):
+        raise RuntimeError(f"generate: {r['error']}")
+
+    plan = _spread_plan(env, source)
+    for target, shard_ids in plan.items():
+        if target == source or not shard_ids:
+            continue
+        shards = ",".join(str(s) for s in shard_ids)
+        r = http_json(
+            "POST",
+            f"http://{target}/admin/ec/copy?volume={vid}&collection={collection}"
+            f"&source={source}&shards={shards}",
+        )
+        if r.get("error"):
+            raise RuntimeError(f"copy to {target}: {r['error']}")
+        http_json("POST", f"http://{target}/admin/ec/mount?volume={vid}")
+        http_json(
+            "POST",
+            f"http://{source}/admin/ec/delete_shards?volume={vid}&shards={shards}",
+        )
+    http_json("POST", f"http://{source}/admin/ec/mount?volume={vid}")
+
+    if delete_original:
+        for url in locations:
+            http_json("POST", f"http://{url}/admin/delete_volume?volume={vid}")
+    return {"volume": vid, "spread": {t: s for t, s in plan.items() if s}}
+
+
+def _spread_plan(env: CommandEnv, source: str) -> dict[str, list[int]]:
+    """Round-robin balanced distribution (balancedEcDistribution,
+    command_ec_encode.go:209): spread 14 shards across all servers, source
+    keeps its share."""
+    nodes = sorted(n["url"] for n in env.data_nodes())
+    if source in nodes:  # source first so it keeps the remainder share
+        nodes.remove(source)
+        nodes.insert(0, source)
+    plan: dict[str, list[int]] = {n: [] for n in nodes}
+    for sid in range(TOTAL_SHARDS):
+        plan[nodes[sid % len(nodes)]].append(sid)
+    return plan
+
+
+def ec_rebuild(env: CommandEnv, vid: int, collection: str = "") -> dict:
+    """command_ec_rebuild.go:57: find missing shards, pick the node with the
+    most free room as rebuilder, copy enough sibling shards there, rebuild,
+    mount, then drop the copied-in temporaries."""
+    by_shard = env.ec_shard_locations(vid)
+    present = set(by_shard)
+    missing = sorted(set(range(TOTAL_SHARDS)) - present)
+    if not missing:
+        return {"volume": vid, "rebuilt": []}
+    if len(present) < 10:
+        raise RuntimeError(
+            f"volume {vid}: only {len(present)} shards survive, cannot rebuild"
+        )
+
+    # rebuilder = node already holding the most shards (minimizes copying)
+    holder_counts: dict[str, int] = {}
+    for sid, urls in by_shard.items():
+        for u in urls:
+            holder_counts[u] = holder_counts.get(u, 0) + 1
+    rebuilder = max(holder_counts, key=holder_counts.get)
+
+    local = {sid for sid, urls in by_shard.items() if rebuilder in urls}
+    needed = [sid for sid in sorted(present - local)]
+    copied_in = []
+    for sid in needed:
+        if len(local) + len(copied_in) >= 10:
+            break
+        src = by_shard[sid][0]
+        r = http_json(
+            "POST",
+            f"http://{rebuilder}/admin/ec/copy?volume={vid}&collection={collection}"
+            f"&source={src}&shards={sid}&copy_ecx=false&copy_vif=false",
+        )
+        if r.get("error"):
+            raise RuntimeError(f"copy shard {sid}: {r['error']}")
+        copied_in.append(sid)
+
+    r = http_json("POST", f"http://{rebuilder}/admin/ec/rebuild?volume={vid}")
+    if r.get("error"):
+        raise RuntimeError(f"rebuild: {r['error']}")
+    rebuilt = r.get("rebuilt_shards", [])
+    # the rebuild regenerates every locally-absent shard; keep only the
+    # truly-missing ones — drop copied-in temporaries AND regenerated
+    # duplicates of shards still live elsewhere (prepareDataToRecover
+    # cleanup, command_ec_rebuild.go:187)
+    to_drop = sorted((set(copied_in) | set(rebuilt)) - set(missing))
+    if to_drop:
+        shards = ",".join(str(s) for s in to_drop)
+        http_json(
+            "POST",
+            f"http://{rebuilder}/admin/ec/delete_shards?volume={vid}&shards={shards}",
+        )
+    http_json("POST", f"http://{rebuilder}/admin/ec/mount?volume={vid}")
+    return {
+        "volume": vid,
+        "rebuilt": sorted(set(rebuilt) & set(missing)),
+        "rebuilder": rebuilder,
+    }
+
+
+def ec_balance(env: CommandEnv, collection: str = "") -> dict:
+    """command_ec_balance.go: even out shard counts across servers."""
+    nodes = [n["url"] for n in env.data_nodes()]
+    if not nodes:
+        return {"moves": []}
+    # collect all ec volumes
+    vids = set()
+    for n in env.data_nodes():
+        st = env.node_status(n["url"])
+        for s in st.get("ec", []):
+            vids.add(s["id"])
+    moves = []
+    for vid in sorted(vids):
+        by_shard = env.ec_shard_locations(vid)
+        counts = {u: 0 for u in nodes}
+        holders: dict[int, str] = {}
+        for sid, urls in by_shard.items():
+            if urls:
+                holders[sid] = urls[0]
+                counts[urls[0]] = counts.get(urls[0], 0) + 1
+        target = -(-len(holders) // len(nodes))  # ceil
+        for sid, holder in sorted(holders.items()):
+            if counts[holder] <= target:
+                continue
+            dest = min(counts, key=counts.get)
+            if counts[dest] >= target or dest == holder:
+                continue
+            r = http_json(
+                "POST",
+                f"http://{dest}/admin/ec/copy?volume={vid}&collection={collection}"
+                f"&source={holder}&shards={sid}",
+            )
+            if r.get("error"):
+                continue
+            http_json("POST", f"http://{dest}/admin/ec/mount?volume={vid}")
+            http_json(
+                "POST",
+                f"http://{holder}/admin/ec/delete_shards?volume={vid}&shards={sid}",
+            )
+            counts[holder] -= 1
+            counts[dest] += 1
+            moves.append({"vid": vid, "shard": sid, "from": holder, "to": dest})
+    return {"moves": moves}
+
+
+def volume_fix_replication(env: CommandEnv) -> dict:
+    """command_volume_fix_replication.go: re-replicate under-replicated
+    volumes by copying the .dat/.idx to a fresh server."""
+    fixed = []
+    seen: dict[int, dict] = {}
+    for v in volume_list(env):
+        seen.setdefault(
+            v["id"],
+            {
+                "replicas": [],
+                "rp": v["replica_placement"],
+                "collection": v.get("collection", ""),
+            },
+        )
+        seen[v["id"]]["replicas"].append(v["server"])
+    nodes = [n["url"] for n in env.data_nodes()]
+    for vid, info in seen.items():
+        from ..storage.replica_placement import ReplicaPlacement
+
+        want = ReplicaPlacement.from_byte(info["rp"]).copy_count()
+        have = len(info["replicas"])
+        if have >= want:
+            continue
+        candidates = [n for n in nodes if n not in info["replicas"]]
+        for target in candidates[: want - have]:
+            src = info["replicas"][0]
+            if _copy_volume(env, vid, src, target, info["collection"]):
+                fixed.append({"vid": vid, "to": target})
+    return {"fixed": fixed}
+
+
+def _copy_volume(
+    env: CommandEnv, vid: int, source: str, target: str, collection: str = ""
+) -> bool:
+    """VolumeCopy analog: the target pulls .dat/.idx from source and loads."""
+    r = http_json(
+        "POST",
+        f"http://{target}/admin/volume_copy?volume={vid}&source={source}"
+        f"&collection={collection}",
+    )
+    return not r.get("error")

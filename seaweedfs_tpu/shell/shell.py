@@ -1,0 +1,101 @@
+"""Interactive admin REPL (reference: `weed shell`, shell/commands.go)."""
+
+from __future__ import annotations
+
+import json
+import shlex
+
+from . import commands as C
+from .commands import CommandEnv
+
+HELP = """commands:
+  cluster.status                      show topology
+  volume.list                         list volumes on all servers
+  volume.vacuum [-garbageThreshold=X] compact garbage volumes
+  volume.delete -volumeId=N           delete a volume everywhere
+  volume.mark.readonly -volumeId=N    seal a volume
+  volume.fix.replication              re-replicate under-replicated volumes
+  ec.encode -volumeId=N [-collection=C]   erasure-code + spread a volume
+  ec.rebuild -volumeId=N                  rebuild missing shards
+  ec.balance                              even out shard spread
+  collection.list | collection.delete -collection=C
+  lock | unlock
+  help | exit
+"""
+
+
+def _flags(parts: list[str]) -> dict[str, str]:
+    out = {}
+    for p in parts:
+        if p.startswith("-") and "=" in p:
+            k, v = p[1:].split("=", 1)
+            out[k] = v
+    return out
+
+
+def run_command(env: CommandEnv, line: str) -> object:
+    parts = shlex.split(line.strip())
+    if not parts:
+        return None
+    cmd, flags = parts[0], _flags(parts[1:])
+    if cmd in ("exit", "quit"):
+        raise EOFError
+    if cmd == "help":
+        return HELP
+    if cmd == "cluster.status":
+        return C.cluster_status(env)
+    if cmd == "volume.list":
+        return C.volume_list(env)
+    if cmd == "volume.vacuum":
+        return C.volume_vacuum(env, float(flags.get("garbageThreshold", 0.3)))
+    if cmd == "volume.delete":
+        C.volume_delete(env, int(flags["volumeId"]))
+        return "ok"
+    if cmd == "volume.mark.readonly":
+        C.volume_mark_readonly(env, int(flags["volumeId"]))
+        return "ok"
+    if cmd == "volume.fix.replication":
+        return C.volume_fix_replication(env)
+    if cmd == "ec.encode":
+        return C.ec_encode(
+            env, int(flags["volumeId"]), flags.get("collection", "")
+        )
+    if cmd == "ec.rebuild":
+        return C.ec_rebuild(
+            env, int(flags["volumeId"]), flags.get("collection", "")
+        )
+    if cmd == "ec.balance":
+        return C.ec_balance(env, flags.get("collection", ""))
+    if cmd == "collection.list":
+        return C.collection_list(env)
+    if cmd == "collection.delete":
+        return C.collection_delete(env, flags["collection"])
+    if cmd == "lock":
+        return env.lock()
+    if cmd == "unlock":
+        env.unlock()
+        return "ok"
+    return f"unknown command {cmd!r} (try help)"
+
+
+def run_shell(master: str) -> None:
+    env = CommandEnv(master)
+    print(f"connected to master {master}; 'help' for commands")
+    while True:
+        try:
+            line = input("> ")
+        except (EOFError, KeyboardInterrupt):
+            break
+        try:
+            result = run_command(env, line)
+        except EOFError:
+            break
+        except Exception as e:
+            print(f"error: {e}")
+            continue
+        if result is not None:
+            if isinstance(result, str):
+                print(result)
+            else:
+                print(json.dumps(result, indent=2, default=str))
+    env.unlock()

