@@ -70,6 +70,22 @@ def cmd_server(args):
     _wait_forever()
 
 
+def cmd_filer(args):
+    from .server.filer_server import FilerServer
+
+    fs = FilerServer(
+        host=args.ip,
+        port=args.port,
+        master_url=args.master,
+        chunk_size=args.chunk_size_mb * 1024 * 1024,
+        db_path=args.db,
+        collection=args.collection,
+        replication=args.replication,
+    ).start()
+    print(f"filer on {fs.url} → master {args.master}")
+    _wait_forever()
+
+
 def cmd_upload(args):
     from . import operation
 
@@ -224,6 +240,16 @@ def main(argv=None):
     s.add_argument("-max", type=int, default=7)
     s.add_argument("-ec.backend", dest="ec_backend", default="")
     s.set_defaults(fn=cmd_server)
+
+    f = sub.add_parser("filer", help="run a filer server")
+    f.add_argument("-ip", default="127.0.0.1")
+    f.add_argument("-port", type=int, default=8888)
+    f.add_argument("-master", default="127.0.0.1:9333")
+    f.add_argument("-chunkSizeMB", dest="chunk_size_mb", type=int, default=32)
+    f.add_argument("-db", default=":memory:")
+    f.add_argument("-collection", default="")
+    f.add_argument("-replication", default="")
+    f.set_defaults(fn=cmd_filer)
 
     u = sub.add_parser("upload", help="upload files")
     u.add_argument("-master", default="127.0.0.1:9333")

@@ -1,0 +1,6 @@
+"""Filer: directory/metadata layer over the object store.
+
+Mirrors `weed/filer/`: entries are paths with attributes and chunk lists of
+object-store fids; stores are pluggable (sqlite replaces leveldb/SQL here);
+every mutation feeds a meta log with subscribe/replay.
+"""

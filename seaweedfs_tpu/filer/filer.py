@@ -1,0 +1,193 @@
+"""Filer core: path→Entry CRUD with parent-dir auto-creation + deletion GC.
+
+Mirrors `weed/filer/filer.go:30-253` + `filer_delete_entry.go`: creates
+missing parent directories on insert, recursive delete collects chunk fids
+for the deletion queue, every mutation notifies the meta log.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from .entry import Entry, FileChunk
+from .filechunks import compact_file_chunks, minus_chunks
+from .filerstore import FilerStore, MemoryStore, NotFoundError
+from .meta_log import MetaLog
+
+# purge(fids) — wired to operation.delete_files by the daemon
+ChunkPurger = Callable[[list[str]], None]
+
+
+class Filer:
+    def __init__(
+        self,
+        store: Optional[FilerStore] = None,
+        chunk_purger: Optional[ChunkPurger] = None,
+    ):
+        self.store = store or MemoryStore()
+        self.meta_log = MetaLog()
+        self.chunk_purger = chunk_purger
+        self._lock = threading.RLock()
+        self._ensure_root()
+
+    def _ensure_root(self) -> None:
+        try:
+            self.store.find_entry("/")
+        except NotFoundError:
+            self.store.insert_entry(
+                Entry(full_path="/", is_directory=True, mode=0o755)
+            )
+
+    # -- CRUD (filer.go:131-253) ---------------------------------------------
+    def create_entry(self, entry: Entry, o_excl: bool = False) -> Entry:
+        with self._lock:
+            self._ensure_parents(entry.parent)
+            old = None
+            try:
+                old = self.store.find_entry(entry.full_path)
+            except NotFoundError:
+                pass
+            if old is not None:
+                if o_excl:
+                    raise FileExistsError(entry.full_path)
+                if old.is_directory and not entry.is_directory:
+                    raise IsADirectoryError(entry.full_path)
+            self.store.insert_entry(entry)
+        self.meta_log.append(
+            entry.parent,
+            old.to_dict() if old else None,
+            entry.to_dict(),
+        )
+        # chunks shadowed by the overwrite become garbage
+        if old is not None and old.chunks and self.chunk_purger:
+            garbage = minus_chunks(old.chunks, entry.chunks)
+            if garbage:
+                self.chunk_purger([c.file_id for c in garbage])
+        return entry
+
+    def _ensure_parents(self, dir_path: str) -> None:
+        if dir_path == "/":
+            return
+        try:
+            e = self.store.find_entry(dir_path)
+            if not e.is_directory:
+                raise NotADirectoryError(dir_path)
+            return
+        except NotFoundError:
+            pass
+        parent = dir_path.rsplit("/", 1)[0] or "/"
+        self._ensure_parents(parent)
+        d = Entry(full_path=dir_path, is_directory=True, mode=0o775)
+        self.store.insert_entry(d)
+        self.meta_log.append(parent, None, d.to_dict())
+
+    def find_entry(self, path: str) -> Entry:
+        return self.store.find_entry(path)
+
+    def update_entry(self, entry: Entry) -> Entry:
+        with self._lock:
+            old = self.store.find_entry(entry.full_path)  # must exist
+            self.store.update_entry(entry)
+        self.meta_log.append(entry.parent, old.to_dict(), entry.to_dict())
+        return entry
+
+    def append_chunks(self, path: str, chunks: list[FileChunk]) -> Entry:
+        """AppendToEntry semantics (filer_grpc_server.go)."""
+        with self._lock:
+            try:
+                entry = self.store.find_entry(path)
+            except NotFoundError:
+                entry = Entry(full_path=path)
+            offset = entry.file_size()
+            for c in chunks:
+                c.offset = offset
+                offset += c.size
+            entry.chunks.extend(chunks)
+            entry.mtime = int(time.time())
+            return self.create_entry(entry)
+
+    def delete_entry(
+        self,
+        path: str,
+        recursive: bool = False,
+        ignore_recursive_error: bool = False,
+    ) -> list[str]:
+        """Returns the chunk fids queued for purging
+        (filer_delete_entry.go:15). Chunks are purged once, at the top level."""
+        fids = self._delete_entry(path, recursive, ignore_recursive_error)
+        if fids and self.chunk_purger:
+            self.chunk_purger(fids)
+        return fids
+
+    def _delete_entry(
+        self, path: str, recursive: bool, ignore_recursive_error: bool
+    ) -> list[str]:
+        entry = self.store.find_entry(path)
+        fids: list[str] = []
+        with self._lock:
+            if entry.is_directory:
+                children = list(self.store.list_entries(path, limit=1_000_000))
+                if children and not recursive:
+                    raise OSError(f"directory {path} not empty")
+                for child in children:
+                    try:
+                        fids.extend(
+                            self._delete_entry(child.full_path, True, ignore_recursive_error)
+                        )
+                    except Exception:
+                        if not ignore_recursive_error:
+                            raise
+            fids.extend(c.file_id for c in entry.chunks)
+            self.store.delete_entry(path)
+        self.meta_log.append(
+            entry.parent, entry.to_dict(), None, delete_chunks=bool(fids)
+        )
+        return fids
+
+    def list_entries(
+        self, dir_path: str, start_after: str = "", limit: int = 1000
+    ) -> Iterator[Entry]:
+        return self.store.list_entries(dir_path, start_after, limit)
+
+    # -- maintenance ---------------------------------------------------------
+    def compact_chunks(self, path: str) -> int:
+        """Drop fully-shadowed chunks from an entry; purge them. Returns the
+        number of garbage chunks removed."""
+        entry = self.store.find_entry(path)
+        compacted, garbage = compact_file_chunks(entry.chunks)
+        if garbage:
+            entry.chunks = compacted
+            self.store.update_entry(entry)
+            if self.chunk_purger:
+                self.chunk_purger([c.file_id for c in garbage])
+        return len(garbage)
+
+    def rename(self, old_path: str, new_path: str) -> Entry:
+        """AtomicRenameEntry for files and (recursively) directories."""
+        with self._lock:
+            entry = self.store.find_entry(old_path)
+            if entry.is_directory:
+                for child in list(self.store.list_entries(old_path, limit=1_000_000)):
+                    self.rename(
+                        child.full_path, new_path + "/" + child.name
+                    )
+            # an overwritten destination's chunks become garbage
+            displaced: list[str] = []
+            try:
+                dest = self.store.find_entry(new_path)
+                displaced = [
+                    c.file_id for c in minus_chunks(dest.chunks, entry.chunks)
+                ]
+            except NotFoundError:
+                pass
+            new_entry = Entry.from_dict(entry.to_dict())
+            new_entry.full_path = new_path
+            self._ensure_parents(new_entry.parent)
+            self.store.insert_entry(new_entry)
+            self.store.delete_entry(old_path)
+        self.meta_log.append(entry.parent, entry.to_dict(), new_entry.to_dict())
+        if displaced and self.chunk_purger:
+            self.chunk_purger(displaced)
+        return new_entry

@@ -1,0 +1,208 @@
+"""FilerStore plugins: the uniform KV/SQL adapter interface.
+
+Mirrors `weed/filer/filerstore.go:20`: insert/update/find/delete/
+delete_folder_children/list + KV. Two implementations:
+
+- MemoryStore: dict-backed (tests, scratch)
+- SqliteStore: stdlib sqlite3 standing in for the reference's leveldb
+  default and abstract_sql stores (same dirhash+name keying scheme as
+  `abstract_sql/abstract_sql_store.go`)
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from typing import Iterator, Optional
+
+from .entry import Entry
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class FilerStore:
+    def insert_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def find_entry(self, path: str) -> Entry:
+        raise NotImplementedError
+
+    def delete_entry(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete_folder_children(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list_entries(
+        self, dir_path: str, start_after: str = "", limit: int = 1000
+    ) -> Iterator[Entry]:
+        raise NotImplementedError
+
+    # KV (filerstore.go KvPut/KvGet — used for offsets/checkpoints)
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def _norm(path: str) -> str:
+    if not path.startswith("/"):
+        path = "/" + path
+    if len(path) > 1:
+        path = path.rstrip("/")
+    return path
+
+
+class MemoryStore(FilerStore):
+    def __init__(self) -> None:
+        self._entries: dict[str, Entry] = {}
+        self._kv: dict[bytes, bytes] = {}
+        self._lock = threading.RLock()
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._entries[_norm(entry.full_path)] = entry
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry:
+        e = self._entries.get(_norm(path))
+        if e is None:
+            raise NotFoundError(path)
+        return e
+
+    def delete_entry(self, path: str) -> None:
+        with self._lock:
+            self._entries.pop(_norm(path), None)
+
+    def delete_folder_children(self, path: str) -> None:
+        prefix = _norm(path)
+        prefix = prefix if prefix.endswith("/") else prefix + "/"
+        with self._lock:
+            for k in [k for k in self._entries if k.startswith(prefix)]:
+                del self._entries[k]
+
+    def list_entries(self, dir_path: str, start_after: str = "", limit: int = 1000):
+        d = _norm(dir_path)
+        d_prefix = d if d.endswith("/") else d + "/"
+        names = []
+        with self._lock:
+            for k, e in self._entries.items():
+                if k.startswith(d_prefix) and "/" not in k[len(d_prefix) :]:
+                    names.append((k[len(d_prefix) :], e))
+        names.sort()
+        count = 0
+        for name, e in names:
+            if start_after and name <= start_after:
+                continue
+            yield e
+            count += 1
+            if count >= limit:
+                return
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        self._kv[key] = value
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._kv.get(key)
+
+
+class SqliteStore(FilerStore):
+    """Entries keyed (dir, name) like abstract_sql; JSON meta blob."""
+
+    def __init__(self, db_path: str = ":memory:"):
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS filemeta ("
+                " dir TEXT NOT NULL, name TEXT NOT NULL, meta TEXT NOT NULL,"
+                " PRIMARY KEY (dir, name))"
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)"
+            )
+            self._db.commit()
+
+    @staticmethod
+    def _split(path: str) -> tuple[str, str]:
+        path = _norm(path)
+        if path == "/":
+            return "", "/"
+        d, _, name = path.rpartition("/")
+        return d or "/", name
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = self._split(entry.full_path)
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO filemeta (dir, name, meta) VALUES (?,?,?)",
+                (d, name, json.dumps(entry.to_dict())),
+            )
+            self._db.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry:
+        d, name = self._split(path)
+        with self._lock:
+            row = self._db.execute(
+                "SELECT meta FROM filemeta WHERE dir=? AND name=?", (d, name)
+            ).fetchone()
+        if row is None:
+            raise NotFoundError(path)
+        return Entry.from_dict(json.loads(row[0]))
+
+    def delete_entry(self, path: str) -> None:
+        d, name = self._split(path)
+        with self._lock:
+            self._db.execute(
+                "DELETE FROM filemeta WHERE dir=? AND name=?", (d, name)
+            )
+            self._db.commit()
+
+    def delete_folder_children(self, path: str) -> None:
+        p = _norm(path)
+        with self._lock:
+            self._db.execute("DELETE FROM filemeta WHERE dir=?", (p,))
+            self._db.execute(
+                "DELETE FROM filemeta WHERE dir LIKE ?", (p.rstrip("/") + "/%",)
+            )
+            self._db.commit()
+
+    def list_entries(self, dir_path: str, start_after: str = "", limit: int = 1000):
+        d = _norm(dir_path)
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT meta FROM filemeta WHERE dir=? AND name>? "
+                "ORDER BY name LIMIT ?",
+                (d, start_after, limit),
+            ).fetchall()
+        for (meta,) in rows:
+            yield Entry.from_dict(json.loads(meta))
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)", (key, value)
+            )
+            self._db.commit()
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._db.execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

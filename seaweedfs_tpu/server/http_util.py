@@ -18,6 +18,7 @@ class JsonHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     routes: list[tuple[str, str, Callable]] = []
     server_ctx: Any = None
+    extra_headers: Optional[dict] = None  # handlers may set per-request
 
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -47,6 +48,9 @@ class JsonHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (self.extra_headers or {}).items():
+            self.send_header(k, v)
+        self.extra_headers = None
         self.end_headers()
         if not head_only:  # HEAD: headers only, or keep-alive framing breaks
             self.wfile.write(data)

@@ -1,0 +1,188 @@
+"""Filer core + chunk math + stores + meta log (no daemons)."""
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Entry, FileChunk
+from seaweedfs_tpu.filer.filechunks import (
+    compact_file_chunks,
+    etag_of_chunks,
+    non_overlapping_visible_intervals,
+    total_size,
+    view_from_chunks,
+)
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filerstore import MemoryStore, NotFoundError, SqliteStore
+
+
+# -- chunk math ---------------------------------------------------------------
+def ch(fid, offset, size, mtime):
+    return FileChunk(file_id=fid, offset=offset, size=size, mtime=mtime)
+
+
+def test_visible_intervals_simple_append():
+    chunks = [ch("a", 0, 100, 1), ch("b", 100, 50, 2)]
+    vis = non_overlapping_visible_intervals(chunks)
+    assert [(v.start, v.stop, v.file_id) for v in vis] == [(0, 100, "a"), (100, 150, "b")]
+
+
+def test_visible_intervals_full_overwrite():
+    chunks = [ch("a", 0, 100, 1), ch("b", 0, 100, 2)]
+    vis = non_overlapping_visible_intervals(chunks)
+    assert [(v.start, v.stop, v.file_id) for v in vis] == [(0, 100, "b")]
+
+
+def test_visible_intervals_partial_overwrite_splits():
+    chunks = [ch("a", 0, 100, 1), ch("b", 30, 40, 2)]
+    vis = non_overlapping_visible_intervals(chunks)
+    assert [(v.start, v.stop, v.file_id, v.chunk_offset) for v in vis] == [
+        (0, 30, "a", 0),
+        (30, 70, "b", 0),
+        (70, 100, "a", 70),
+    ]
+
+
+def test_visible_intervals_multiple_random_overwrites():
+    # brute-force model: byte → winning chunk
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    chunks = []
+    model = {}
+    for t in range(1, 40):
+        off = int(rng.integers(0, 500))
+        size = int(rng.integers(1, 120))
+        fid = f"f{t}"
+        chunks.append(ch(fid, off, size, t))
+        for b in range(off, off + size):
+            model[b] = (fid, b - off)
+    vis = non_overlapping_visible_intervals(chunks)
+    # intervals are disjoint, sorted, and match the model byte-for-byte
+    for i in range(1, len(vis)):
+        assert vis[i - 1].stop <= vis[i].start
+    for v in vis:
+        for b in range(v.start, v.stop):
+            fid, in_chunk = model[b]
+            assert v.file_id == fid
+            assert v.chunk_offset + (b - v.start) == in_chunk
+
+
+def test_view_from_chunks_range():
+    chunks = [ch("a", 0, 100, 1), ch("b", 100, 100, 2)]
+    views = view_from_chunks(chunks, 50, 100)
+    assert [(v.file_id, v.offset, v.size, v.logic_offset) for v in views] == [
+        ("a", 50, 50, 50),
+        ("b", 0, 50, 100),
+    ]
+
+
+def test_compact_chunks_finds_garbage():
+    chunks = [ch("a", 0, 100, 1), ch("b", 0, 100, 2), ch("c", 0, 50, 3)]
+    compacted, garbage = compact_file_chunks(chunks)
+    assert {c.file_id for c in garbage} == {"a"}
+    assert {c.file_id for c in compacted} == {"b", "c"}
+
+
+def test_etag_and_size():
+    chunks = [ch("a", 0, 100, 1), ch("b", 100, 100, 2)]
+    chunks[0].etag, chunks[1].etag = "e1", "e2"
+    assert total_size(chunks) == 200
+    assert etag_of_chunks(chunks).endswith("-2")
+    assert etag_of_chunks(chunks[:1]) == "e1"
+
+
+# -- stores -------------------------------------------------------------------
+@pytest.mark.parametrize("store_cls", [MemoryStore, SqliteStore])
+def test_store_crud_and_listing(store_cls):
+    store = store_cls()
+    store.insert_entry(Entry(full_path="/d", is_directory=True))
+    for name in ("b.txt", "a.txt", "c.txt"):
+        store.insert_entry(Entry(full_path=f"/d/{name}"))
+    store.insert_entry(Entry(full_path="/d/sub", is_directory=True))
+    store.insert_entry(Entry(full_path="/d/sub/deep.txt"))
+
+    assert store.find_entry("/d/a.txt").name == "a.txt"
+    names = [e.name for e in store.list_entries("/d")]
+    assert names == ["a.txt", "b.txt", "c.txt", "sub"]
+    # pagination
+    names = [e.name for e in store.list_entries("/d", start_after="b.txt")]
+    assert names == ["c.txt", "sub"]
+
+    store.delete_entry("/d/a.txt")
+    with pytest.raises(NotFoundError):
+        store.find_entry("/d/a.txt")
+
+    store.delete_folder_children("/d")
+    assert list(store.list_entries("/d")) == []
+    # kv
+    store.kv_put(b"k", b"v")
+    assert store.kv_get(b"k") == b"v"
+    assert store.kv_get(b"nope") is None
+
+
+# -- filer core ---------------------------------------------------------------
+def test_filer_parent_auto_creation():
+    f = Filer()
+    f.create_entry(Entry(full_path="/a/b/c/file.txt"))
+    assert f.find_entry("/a").is_directory
+    assert f.find_entry("/a/b/c").is_directory
+    names = [e.name for e in f.list_entries("/a/b/c")]
+    assert names == ["file.txt"]
+
+
+def test_filer_recursive_delete_collects_fids():
+    purged = []
+    f = Filer(chunk_purger=purged.extend)
+    f.create_entry(
+        Entry(full_path="/x/f1", chunks=[ch("1,ab", 0, 10, 1), ch("1,cd", 10, 10, 2)])
+    )
+    f.create_entry(Entry(full_path="/x/sub/f2", chunks=[ch("2,ef", 0, 5, 1)]))
+    with pytest.raises(OSError):
+        f.delete_entry("/x")  # not recursive
+    fids = f.delete_entry("/x", recursive=True)
+    assert sorted(fids) == ["1,ab", "1,cd", "2,ef"]
+    assert sorted(purged) == ["1,ab", "1,cd", "2,ef"]
+    with pytest.raises(NotFoundError):
+        f.find_entry("/x")
+
+
+def test_filer_overwrite_purges_shadowed_chunks():
+    purged = []
+    f = Filer(chunk_purger=purged.extend)
+    f.create_entry(Entry(full_path="/f", chunks=[ch("1,old", 0, 10, 1)]))
+    f.create_entry(Entry(full_path="/f", chunks=[ch("1,new", 0, 20, 2)]))
+    assert purged == ["1,old"]
+
+
+def test_filer_rename_directory():
+    f = Filer()
+    f.create_entry(Entry(full_path="/old/a.txt", chunks=[ch("1,aa", 0, 5, 1)]))
+    f.create_entry(Entry(full_path="/old/sub/b.txt"))
+    f.rename("/old", "/new")
+    assert f.find_entry("/new/a.txt").chunks[0].file_id == "1,aa"
+    assert f.find_entry("/new/sub/b.txt")
+    with pytest.raises(NotFoundError):
+        f.find_entry("/old/a.txt")
+
+
+def test_filer_meta_log_subscribe():
+    f = Filer()
+    events = []
+    f.meta_log.subscribe("test", events.append)
+    f.create_entry(Entry(full_path="/logged.txt"))
+    f.delete_entry("/logged.txt")
+    kinds = [(e.old_entry is None, e.new_entry is None) for e in events]
+    assert (True, False) in kinds  # create
+    assert (False, True) in kinds  # delete
+    # replay from the beginning sees everything
+    replayed = []
+    f.meta_log.subscribe("late", replayed.append, since_ts_ns=0)
+    assert len(replayed) == len(events)
+
+
+def test_filer_append_chunks():
+    f = Filer()
+    f.append_chunks("/log", [ch("1,a", 0, 10, 1)])
+    f.append_chunks("/log", [ch("1,b", 0, 15, 2)])
+    e = f.find_entry("/log")
+    assert e.file_size() == 25
+    assert [c.offset for c in e.chunks] == [0, 10]

@@ -1,0 +1,126 @@
+"""Filer daemon e2e: auto-chunking over a real master+volume cluster."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("filercluster")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volumes = [
+        VolumeServer(
+            [str(tmp / f"srv{i}")],
+            port=free_port(),
+            master_url=master.url,
+            max_volume_count=20,
+            pulse_seconds=0.5,
+        ).start()
+        for i in range(2)
+    ]
+    filer = FilerServer(
+        port=free_port(),
+        master_url=master.url,
+        chunk_size=64 * 1024,  # small chunks to exercise multi-chunk files
+    ).start()
+    time.sleep(0.6)
+    yield master, volumes, filer
+    filer.stop()
+    for v in volumes:
+        v.stop()
+    master.stop()
+
+
+def test_small_file_roundtrip(cluster):
+    _, _, filer = cluster
+    status, _ = http_bytes("POST", f"http://{filer.url}/docs/hello.txt", b"hi filer")
+    assert status == 201
+    status, data = http_bytes("GET", f"http://{filer.url}/docs/hello.txt")
+    assert status == 200 and data == b"hi filer"
+
+
+def test_multi_chunk_file(cluster):
+    _, _, filer = cluster
+    rng = np.random.default_rng(0)
+    blob = rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()  # ~5 chunks
+    status, resp = http_bytes("POST", f"http://{filer.url}/big/file.bin", blob)
+    assert status == 201
+    import json
+
+    assert json.loads(resp)["chunks"] == 5
+    status, data = http_bytes("GET", f"http://{filer.url}/big/file.bin")
+    assert status == 200 and data == blob
+
+
+def test_range_read(cluster):
+    _, _, filer = cluster
+    blob = bytes(range(256)) * 1000  # 256000 bytes, 4 chunks
+    http_bytes("POST", f"http://{filer.url}/r/range.bin", blob)
+    import urllib.request
+
+    req = urllib.request.Request(f"http://{filer.url}/r/range.bin")
+    req.add_header("Range", "bytes=65530-65545")  # crosses a chunk boundary
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 206
+        assert resp.read() == blob[65530:65546]
+
+
+def test_directory_listing(cluster):
+    _, _, filer = cluster
+    for name in ("a.txt", "b.txt"):
+        http_bytes("POST", f"http://{filer.url}/listdir/{name}", b"x")
+    r = http_json("GET", f"http://{filer.url}/listdir/")
+    names = [e["name"] for e in r["entries"]]
+    assert names == ["a.txt", "b.txt"]
+    assert all(not e["is_directory"] for e in r["entries"])
+
+
+def test_overwrite_and_delete_purges_chunks(cluster):
+    master, _, filer = cluster
+    blob1 = b"version one" * 1000
+    blob2 = b"version two!" * 1000
+    http_bytes("POST", f"http://{filer.url}/ow/f.txt", blob1)
+    http_bytes("POST", f"http://{filer.url}/ow/f.txt", blob2)
+    _, data = http_bytes("GET", f"http://{filer.url}/ow/f.txt")
+    assert data == blob2
+
+    status, _ = http_bytes("DELETE", f"http://{filer.url}/ow/f.txt")
+    assert status == 200
+    status, _ = http_bytes("GET", f"http://{filer.url}/ow/f.txt")
+    assert status == 404
+
+
+def test_recursive_delete(cluster):
+    _, _, filer = cluster
+    http_bytes("POST", f"http://{filer.url}/tree/x/1.txt", b"1")
+    http_bytes("POST", f"http://{filer.url}/tree/x/y/2.txt", b"2")
+    status, resp = http_bytes("DELETE", f"http://{filer.url}/tree")
+    assert status == 409  # not empty, not recursive
+    status, resp = http_bytes("DELETE", f"http://{filer.url}/tree?recursive=true")
+    assert status == 200
+    status, _ = http_bytes("GET", f"http://{filer.url}/tree/x/1.txt")
+    assert status == 404
+
+
+def test_empty_file(cluster):
+    _, _, filer = cluster
+    status, _ = http_bytes("POST", f"http://{filer.url}/empty.txt", b"")
+    assert status == 201
+    status, data = http_bytes("GET", f"http://{filer.url}/empty.txt")
+    assert status == 200 and data == b""
