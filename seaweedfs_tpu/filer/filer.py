@@ -113,11 +113,15 @@ class Filer:
         path: str,
         recursive: bool = False,
         ignore_recursive_error: bool = False,
+        skip_chunk_purge: bool = False,
     ) -> list[str]:
         """Returns the chunk fids queued for purging
-        (filer_delete_entry.go:15). Chunks are purged once, at the top level."""
+        (filer_delete_entry.go:15). Chunks are purged once, at the top level.
+        `skip_chunk_purge` drops the metadata but keeps the chunks — used when
+        chunk ownership moved to another entry (S3 multipart complete,
+        filer_multipart.go)."""
         fids = self._delete_entry(path, recursive, ignore_recursive_error)
-        if fids and self.chunk_purger:
+        if fids and self.chunk_purger and not skip_chunk_purge:
             self.chunk_purger(fids)
         return fids
 

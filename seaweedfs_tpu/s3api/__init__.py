@@ -1,0 +1,10 @@
+"""S3-compatible gateway over the filer (reference: `weed/s3api/`).
+
+Buckets are directories under `/buckets`; objects proxy to the filer;
+multipart uploads assemble chunk lists server-side without copying data
+(`filer_multipart.go`). Authentication implements AWS Signature V4 (header,
+presigned-query, and streaming-chunked flavors) plus legacy V2.
+"""
+
+from .s3api_server import S3ApiServer  # noqa: F401
+from .auth import IAM, Identity  # noqa: F401

@@ -1,0 +1,108 @@
+"""Thin client over the filer HTTP surface used by the S3 gateway.
+
+Stands in for the reference's filer gRPC client (`s3api/filer_util.go`,
+`filer_pb.SeaweedFiler`): entry-level lookup/create for multipart chunk-list
+assembly, plus plain object read/write proxying.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from ..server.http_util import http_bytes, http_json
+
+
+class FilerClient:
+    def __init__(self, filer_url: str):
+        self.base = f"http://{filer_url}"
+
+    def _u(self, path: str, **q) -> str:
+        qs = urllib.parse.urlencode({k: v for k, v in q.items() if v != ""})
+        return self.base + urllib.parse.quote(path) + ("?" + qs if qs else "")
+
+    # -- object level ---------------------------------------------------------
+    def put_object(
+        self,
+        path: str,
+        body: bytes,
+        content_type: str = "",
+        extended: Optional[dict] = None,
+    ) -> dict:
+        req = urllib.request.Request(
+            self._u(path), data=body, method="PUT"
+        )
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        for k, v in (extended or {}).items():
+            req.add_header(f"Seaweed-{k}", v)
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())
+
+    def get_object(
+        self, path: str, rng: Optional[str] = None
+    ) -> tuple[int, bytes, dict]:
+        req = urllib.request.Request(self._u(path), method="GET")
+        if rng:
+            req.add_header("Range", rng)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    # -- entry level ----------------------------------------------------------
+    def get_entry(self, path: str) -> Optional[dict]:
+        status, body = http_bytes("GET", self._u(path, meta="true"))
+        if status != 200:
+            return None
+        return json.loads(body)
+
+    def create_entry(self, path: str, entry: dict) -> None:
+        http_json("POST", self._u(path, meta="true"), body=entry)
+
+    def mkdir(self, path: str) -> None:
+        http_json("POST", self._u(path.rstrip("/") + "/", mkdir="true"))
+
+    def delete(
+        self,
+        path: str,
+        recursive: bool = False,
+        skip_chunk_purge: bool = False,
+    ) -> int:
+        status, _ = http_bytes(
+            "DELETE",
+            self._u(
+                path,
+                recursive="true" if recursive else "",
+                ignoreRecursiveError="true" if recursive else "",
+                skipChunkPurge="true" if skip_chunk_purge else "",
+            ),
+        )
+        return status
+
+    def list(
+        self,
+        dir_path: str,
+        start_after: str = "",
+        limit: int = 1000,
+        prefix: str = "",
+    ) -> list[dict]:
+        status, body = http_bytes(
+            "GET",
+            self._u(
+                dir_path.rstrip("/") + "/",
+                meta="true",
+                lastFileName=start_after,
+                limit=str(limit),
+                prefix=prefix,
+            ),
+        )
+        if status != 200:
+            return []
+        return json.loads(body).get("entries", [])
+
+    def rename(self, old: str, new: str) -> None:
+        http_json("POST", self._u(old, **{"mv.to": new}))

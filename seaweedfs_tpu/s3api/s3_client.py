@@ -1,0 +1,262 @@
+"""Minimal S3 client with AWS SigV4 signing.
+
+Used by tests and as a convenience library (the reference relies on the AWS
+SDKs for this — `test/s3/basic/basic_test.go`). The signing code here is an
+independent implementation of the SigV4 spec (canonical request → string to
+sign → HMAC chain) so that client and server don't share the same bug.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import urllib.parse
+import urllib.request
+from datetime import datetime, timezone
+from typing import Optional
+
+
+class S3Client:
+    def __init__(
+        self,
+        endpoint: str,
+        access_key: str = "",
+        secret_key: str = "",
+        region: str = "us-east-1",
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    # -- SigV4 ---------------------------------------------------------------
+    def _sign(
+        self, method: str, path: str, query: dict, headers: dict, body: bytes
+    ) -> dict:
+        if not self.access_key:
+            return headers
+        now = datetime.now(tz=timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        host = urllib.parse.urlparse(self.endpoint).netloc
+        headers = dict(headers)
+        # streaming uploads pre-set the payload marker; don't overwrite it
+        payload_hash = headers.get(
+            "X-Amz-Content-Sha256", hashlib.sha256(body).hexdigest()
+        )
+        headers["Host"] = host
+        headers["X-Amz-Date"] = amz_date
+        headers["X-Amz-Content-Sha256"] = payload_hash
+        signed = sorted(k.lower() for k in headers)
+        canonical_headers = "".join(
+            f"{k}:{' '.join(str(headers[h]).split())}\n"
+            for k, h in sorted((k.lower(), k) for k in headers)
+        )
+        canonical_query = "&".join(
+            urllib.parse.quote(k, safe="~-._")
+            + "="
+            + urllib.parse.quote(str(v), safe="~-._")
+            for k, v in sorted(query.items())
+        )
+        canonical = "\n".join(
+            [
+                method,
+                urllib.parse.quote(path, safe="/~-._"),
+                canonical_query,
+                canonical_headers,
+                ";".join(signed),
+                payload_hash,
+            ]
+        )
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        sts = "\n".join(
+            [
+                "AWS4-HMAC-SHA256",
+                amz_date,
+                scope,
+                hashlib.sha256(canonical.encode()).hexdigest(),
+            ]
+        )
+
+        def h(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        key = h(
+            h(
+                h(h(("AWS4" + self.secret_key).encode(), datestamp), self.region),
+                "s3",
+            ),
+            "aws4_request",
+        )
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+        )
+        return headers
+
+    def presign(self, method: str, path: str, expires: int = 3600) -> str:
+        """Presigned URL (query-string auth)."""
+        now = datetime.now(tz=timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        host = urllib.parse.urlparse(self.endpoint).netloc
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        query = {
+            "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+            "X-Amz-Credential": f"{self.access_key}/{scope}",
+            "X-Amz-Date": amz_date,
+            "X-Amz-Expires": str(expires),
+            "X-Amz-SignedHeaders": "host",
+        }
+        canonical_query = "&".join(
+            urllib.parse.quote(k, safe="~-._")
+            + "="
+            + urllib.parse.quote(v, safe="~-._")
+            for k, v in sorted(query.items())
+        )
+        canonical = "\n".join(
+            [
+                method,
+                urllib.parse.quote(path, safe="/~-._"),
+                canonical_query,
+                f"host:{host}\n",
+                "host",
+                "UNSIGNED-PAYLOAD",
+            ]
+        )
+        sts = "\n".join(
+            [
+                "AWS4-HMAC-SHA256",
+                amz_date,
+                scope,
+                hashlib.sha256(canonical.encode()).hexdigest(),
+            ]
+        )
+
+        def h(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        key = h(
+            h(
+                h(h(("AWS4" + self.secret_key).encode(), datestamp), self.region),
+                "s3",
+            ),
+            "aws4_request",
+        )
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        return (
+            f"{self.endpoint}{urllib.parse.quote(path, safe='/~-._')}"
+            f"?{canonical_query}&X-Amz-Signature={sig}"
+        )
+
+    # -- transport -----------------------------------------------------------
+    def request(
+        self,
+        method: str,
+        path: str,
+        query: Optional[dict] = None,
+        body: bytes = b"",
+        headers: Optional[dict] = None,
+    ) -> tuple[int, bytes, dict]:
+        query = query or {}
+        headers = self._sign(method, path, query, headers or {}, body)
+        qs = urllib.parse.urlencode(query)
+        url = (
+            self.endpoint
+            + urllib.parse.quote(path, safe="/~-._")
+            + ("?" + qs if qs else "")
+        )
+        req = urllib.request.Request(
+            url, data=body if body else None, method=method, headers=headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    def put_object_streaming(
+        self, bucket: str, key: str, chunks: list[bytes]
+    ) -> tuple[int, bytes, dict]:
+        """Streaming SigV4 upload: aws-chunked framing with the per-chunk
+        signature chain seeded by the header signature."""
+        path = f"/{bucket}/{key}"
+        total = sum(len(c) for c in chunks)
+        headers = self._sign(
+            "PUT",
+            path,
+            {},
+            {
+                "X-Amz-Content-Sha256": "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+                "X-Amz-Decoded-Content-Length": str(total),
+            },
+            b"",
+        )
+        seed = headers["Authorization"].rsplit("Signature=", 1)[1]
+        scope = headers["Authorization"].split("Credential=")[1].split(",")[0]
+        scope = scope.split("/", 1)[1]
+        amz_date = headers["X-Amz-Date"]
+        date, region = scope.split("/")[0], scope.split("/")[1]
+
+        def hm(k, m):
+            return hmac.new(k, m.encode(), hashlib.sha256).digest()
+
+        key_b = hm(
+            hm(hm(hm(("AWS4" + self.secret_key).encode(), date), region), "s3"),
+            "aws4_request",
+        )
+        empty = hashlib.sha256(b"").hexdigest()
+        prev = seed
+        framed = bytearray()
+        for c in list(chunks) + [b""]:
+            sts = "\n".join(
+                [
+                    "AWS4-HMAC-SHA256-PAYLOAD",
+                    amz_date,
+                    scope,
+                    prev,
+                    empty,
+                    hashlib.sha256(c).hexdigest(),
+                ]
+            )
+            prev = hmac.new(key_b, sts.encode(), hashlib.sha256).hexdigest()
+            framed += f"{len(c):x};chunk-signature={prev}\r\n".encode()
+            framed += c + b"\r\n"
+        url = self.endpoint + urllib.parse.quote(path, safe="/~-._")
+        req = urllib.request.Request(
+            url, data=bytes(framed), method="PUT", headers=headers
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    # -- convenience ops -----------------------------------------------------
+    def create_bucket(self, bucket: str):
+        return self.request("PUT", f"/{bucket}")
+
+    def delete_bucket(self, bucket: str):
+        return self.request("DELETE", f"/{bucket}")
+
+    def list_buckets(self):
+        return self.request("GET", "/")
+
+    def put_object(self, bucket: str, key: str, body: bytes, **headers):
+        return self.request("PUT", f"/{bucket}/{key}", body=body, headers=headers)
+
+    def get_object(self, bucket: str, key: str, rng: str = ""):
+        h = {"Range": rng} if rng else {}
+        return self.request("GET", f"/{bucket}/{key}", headers=h)
+
+    def head_object(self, bucket: str, key: str):
+        return self.request("HEAD", f"/{bucket}/{key}")
+
+    def delete_object(self, bucket: str, key: str):
+        return self.request("DELETE", f"/{bucket}/{key}")
+
+    def list_objects(self, bucket: str, v2: bool = False, **params):
+        if v2:
+            params["list-type"] = "2"
+        return self.request("GET", f"/{bucket}", query=params)

@@ -1,0 +1,687 @@
+"""S3-compatible REST server over the filer.
+
+Mirrors `weed/s3api/s3api_server.go:38` (router) and its handler files:
+bucket CRUD (= dirs under `/buckets`, `s3api_bucket_handlers.go`), object
+CRUD proxied to the filer (`s3api_object_handlers.go`), multipart uploads
+assembled by chunk-list concatenation without data copy
+(`filer_multipart.go`), ListObjects v1/v2 (`s3api_objects_list_handlers.go`),
+object tagging (`s3api_object_tagging_handlers.go`), and multi-object delete.
+
+Requests are authenticated by `auth.IAM` (SigV4 header/presigned/streaming +
+SigV2) and authorized per identity action grants (`auth_credentials.go:124`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+import urllib.parse
+import uuid
+from datetime import datetime, timezone
+from http.server import BaseHTTPRequestHandler
+
+from ..server.http_util import start_server
+from . import auth as s3auth
+from .auth import IAM
+from .filer_client import FilerClient
+from .xml_util import error_xml, find_text, findall, parse_xml, to_xml
+
+BUCKETS_DIR = "/buckets"
+UPLOADS_DIR = "/buckets/.uploads"
+TAG_PREFIX = "X-Amz-Tag-"
+
+_ERR_STATUS = {
+    "NoSuchBucket": 404,
+    "NoSuchKey": 404,
+    "NoSuchUpload": 404,
+    "AccessDenied": 403,
+    "SignatureDoesNotMatch": 403,
+    "InvalidAccessKeyId": 403,
+    "ExpiredPresignRequest": 403,
+    "MissingFields": 400,
+    "MalformedXML": 400,
+    "InvalidPart": 400,
+    "BucketAlreadyExists": 409,
+    "BucketNotEmpty": 409,
+    "InternalError": 500,
+}
+
+
+def _iso(ts: float) -> str:
+    return datetime.fromtimestamp(ts, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.000Z"
+    )
+
+
+class S3ApiServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8333,
+        filer_url: str = "127.0.0.1:8888",
+        iam: IAM | None = None,
+    ):
+        self.host, self.port = host, port
+        self.client = FilerClient(filer_url)
+        self.iam = iam or IAM()
+        self._srv = None
+
+    # ---------------------------------------------------------------- helpers
+    def _bucket_dir(self, bucket: str) -> str:
+        return f"{BUCKETS_DIR}/{bucket}"
+
+    def _object_path(self, bucket: str, key: str) -> str:
+        return f"{BUCKETS_DIR}/{bucket}/{key}"
+
+    def _bucket_exists(self, bucket: str) -> bool:
+        e = self.client.get_entry(self._bucket_dir(bucket))
+        return bool(e and e.get("is_directory"))
+
+    # ---------------------------------------------------------------- service
+    def _list_buckets(self, identity):
+        buckets = [
+            {"Name": e["name"], "CreationDate": _iso(e.get("crtime", 0))}
+            for e in self.client.list(BUCKETS_DIR, limit=10000)
+            if e.get("is_directory") and not e["name"].startswith(".")
+        ]
+        return 200, to_xml(
+            "ListAllMyBucketsResult",
+            {
+                "Owner": {"ID": getattr(identity, "name", "") or "anonymous"},
+                "Buckets": {"Bucket": buckets},
+            },
+        )
+
+    # ---------------------------------------------------------------- buckets
+    def _put_bucket(self, bucket):
+        if self._bucket_exists(bucket):
+            return _err("BucketAlreadyExists", bucket)
+        self.client.mkdir(self._bucket_dir(bucket))
+        return 200, b""
+
+    def _head_bucket(self, bucket):
+        if not self._bucket_exists(bucket):
+            return 404, b""
+        return 200, b""
+
+    def _delete_bucket(self, bucket):
+        if not self._bucket_exists(bucket):
+            return _err("NoSuchBucket", bucket)
+        self.client.delete(self._bucket_dir(bucket), recursive=True)
+        return 204, b""
+
+    # ------------------------------------------------------------ list objects
+    def _iter_keys(self, dir_path, rel, prefix, marker):
+        """Sorted recursive key walk with prefix/marker subtree pruning."""
+        start = ""
+        entries = self.client.list(dir_path, start_after=start, limit=100000)
+        for e in entries:
+            if rel == "" and e["name"].startswith("."):
+                continue  # .uploads &co at bucket root
+            key = rel + e["name"]
+            if e.get("is_directory"):
+                sub = key + "/"
+                if prefix and not (
+                    prefix.startswith(sub[: len(prefix)])
+                    or sub.startswith(prefix)
+                ):
+                    continue
+                if marker and sub <= marker and not marker.startswith(sub):
+                    continue
+                yield from self._iter_keys(
+                    dir_path + "/" + e["name"], sub, prefix, marker
+                )
+            else:
+                yield key, e
+
+    def _list_objects(self, bucket, q, v2: bool):
+        if not self._bucket_exists(bucket):
+            return _err("NoSuchBucket", bucket)
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", 1000))
+        if v2:
+            marker = q.get("continuation-token", "") or q.get("start-after", "")
+        else:
+            marker = q.get("marker", "")
+        contents, common = [], []
+        truncated = False
+        for key, e in self._iter_keys(self._bucket_dir(bucket), "", prefix, marker):
+            if prefix and not key.startswith(prefix):
+                continue
+            if marker and key <= marker:
+                continue
+            if delimiter:
+                idx = key.find(delimiter, len(prefix))
+                if idx >= 0:
+                    cp = key[: idx + len(delimiter)]
+                    if marker and cp <= marker:
+                        continue  # whole prefix was already returned
+                    if common and common[-1] == cp:
+                        continue
+                    if len(contents) + len(common) >= max_keys:
+                        truncated = True
+                        break
+                    common.append(cp)
+                    continue
+            if len(contents) + len(common) >= max_keys:
+                truncated = True
+                break
+            size = max(
+                (c["offset"] + c["size"] for c in e.get("chunks", [])), default=0
+            )
+            contents.append(
+                {
+                    "Key": key,
+                    "LastModified": _iso(e.get("mtime", 0)),
+                    "ETag": f'"{e.get("extended", {}).get("md5", "")}"',
+                    "Size": size,
+                    "StorageClass": "STANDARD",
+                }
+            )
+        # marker is exclusive: the next page starts after the last returned
+        # key/prefix (S3 v1 NextMarker / v2 continuation semantics)
+        last_key = contents[-1]["Key"] if contents else ""
+        last_cp = common[-1] if common else ""
+        next_marker = max(last_key, last_cp)
+        result = {
+            "Name": bucket,
+            "Prefix": prefix,
+            "MaxKeys": max_keys,
+            "Delimiter": delimiter,
+            "IsTruncated": truncated,
+            "Contents": contents,
+            "CommonPrefixes": [{"Prefix": p} for p in common],
+        }
+        if v2:
+            result["KeyCount"] = len(contents) + len(common)
+            if truncated:
+                result["NextContinuationToken"] = next_marker
+        else:
+            result["Marker"] = marker
+            if truncated:
+                result["NextMarker"] = next_marker
+        return 200, to_xml("ListBucketResult", result)
+
+    # ---------------------------------------------------------------- objects
+    def _put_object(self, bucket, key, headers, body):
+        if not self._bucket_exists(bucket):
+            return _err("NoSuchBucket", bucket)
+        if key.endswith("/"):
+            self.client.mkdir(self._object_path(bucket, key[:-1]))
+            return 200, b"", {"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'}
+        src = headers.get("X-Amz-Copy-Source", "")
+        if src:
+            return self._copy_object(bucket, key, src)
+        if headers.get("X-Amz-Content-Sha256") == s3auth.STREAMING_PAYLOAD:
+            try:
+                body = s3auth.decode_aws_chunked(
+                    body, verify=self.iam.streaming_context(headers)
+                )
+            except s3auth.ChunkSignatureError:
+                return _err("SignatureDoesNotMatch", key)
+        extended = {
+            k.title(): v
+            for k, v in headers.items()
+            if k.lower().startswith("x-amz-meta-")
+        }
+        r = self.client.put_object(
+            self._object_path(bucket, key),
+            body,
+            content_type=headers.get("Content-Type", ""),
+            extended=extended,
+        )
+        return 200, b"", {"ETag": f'"{r.get("eTag", "")}"'}
+
+    def _copy_object(self, bucket, key, src):
+        src = urllib.parse.unquote(src)
+        if not src.startswith("/"):
+            src = "/" + src
+        sb, _, sk = src[1:].partition("/")
+        status, data, _ = self.client.get_object(self._object_path(sb, sk))
+        if status != 200:
+            return _err("NoSuchKey", src)
+        entry = self.client.get_entry(self._object_path(sb, sk)) or {}
+        dst_path = self._object_path(bucket, key)
+        r = self.client.put_object(
+            dst_path, data, content_type=entry.get("mime", "")
+        )
+        # S3's default COPY directive carries user metadata + tags along
+        src_ext = {
+            k: v for k, v in entry.get("extended", {}).items() if k != "md5"
+        }
+        if src_ext:
+            dst = self.client.get_entry(dst_path)
+            if dst is not None:
+                dst["extended"] = src_ext | {"md5": dst["extended"].get("md5", "")}
+                self.client.create_entry(dst_path, dst)
+        return 200, to_xml(
+            "CopyObjectResult",
+            {"ETag": f'"{r.get("eTag", "")}"', "LastModified": _iso(time.time())},
+        )
+
+    def _get_object(self, bucket, key, headers, head=False):
+        path = self._object_path(bucket, key)
+        entry = self.client.get_entry(path)
+        if entry is None or entry.get("is_directory"):
+            return _err("NoSuchKey", key)
+        size = max(
+            (c["offset"] + c["size"] for c in entry.get("chunks", [])), default=0
+        )
+        resp_headers = {
+            "Content-Type": entry.get("mime") or "application/octet-stream",
+            "ETag": f'"{entry.get("extended", {}).get("md5", "")}"',
+            "Last-Modified": datetime.fromtimestamp(
+                entry.get("mtime", 0), tz=timezone.utc
+            ).strftime("%a, %d %b %Y %H:%M:%S GMT"),
+            "Accept-Ranges": "bytes",
+        }
+        for k, v in entry.get("extended", {}).items():
+            if k.startswith("X-Amz-Meta-"):
+                resp_headers[k] = v
+        if head:
+            resp_headers["Content-Length-Override"] = str(size)
+            return 200, b"", resp_headers
+        rng = headers.get("Range", "")
+        status, data, h = self.client.get_object(path, rng=rng or None)
+        if status not in (200, 206):
+            return _err("NoSuchKey", key)
+        if status == 206 and "Content-Range" in h:
+            resp_headers["Content-Range"] = h["Content-Range"]
+        return status, data, resp_headers
+
+    def _delete_object(self, bucket, key):
+        path = self._object_path(bucket, key.rstrip("/"))
+        entry = self.client.get_entry(path)
+        if entry is None:
+            return 204, b""  # S3: deleting a missing key succeeds
+        if entry.get("is_directory"):
+            if key.endswith("/"):
+                # explicit dir marker: remove only if empty (non-recursive)
+                self.client.delete(path)
+            # a bare key that happens to be an implicit directory is NOT the
+            # object the client named — never recursively wipe the prefix
+            return 204, b""
+        self.client.delete(path)
+        return 204, b""
+
+    def _delete_multiple(self, bucket, body):
+        try:
+            root = parse_xml(body)
+        except Exception:
+            return _err("MalformedXML", bucket)
+        deleted, errors = [], []
+        for obj in findall(root, "Object"):
+            key = find_text(obj, "Key")
+            if not key:
+                continue
+            status, _ = self._delete_object(bucket, key)
+            if status in (200, 204):
+                deleted.append({"Key": key})
+            else:
+                errors.append({"Key": key, "Code": "InternalError"})
+        return 200, to_xml(
+            "DeleteResult", {"Deleted": deleted, "Error": errors}
+        )
+
+    # ---------------------------------------------------------------- tagging
+    def _get_tagging(self, bucket, key):
+        entry = self.client.get_entry(self._object_path(bucket, key))
+        if entry is None:
+            return _err("NoSuchKey", key)
+        tags = [
+            {"Key": k[len(TAG_PREFIX) :], "Value": v}
+            for k, v in entry.get("extended", {}).items()
+            if k.startswith(TAG_PREFIX)
+        ]
+        return 200, to_xml("Tagging", {"TagSet": {"Tag": tags}})
+
+    def _put_tagging(self, bucket, key, body):
+        path = self._object_path(bucket, key)
+        entry = self.client.get_entry(path)
+        if entry is None:
+            return _err("NoSuchKey", key)
+        try:
+            root = parse_xml(body)
+        except Exception:
+            return _err("MalformedXML", key)
+        ext = {
+            k: v
+            for k, v in entry.get("extended", {}).items()
+            if not k.startswith(TAG_PREFIX)
+        }
+        for tag in findall(root, "Tag"):
+            ext[TAG_PREFIX + find_text(tag, "Key")] = find_text(tag, "Value")
+        entry["extended"] = ext
+        self.client.create_entry(path, entry)
+        return 200, b""
+
+    def _delete_tagging(self, bucket, key):
+        path = self._object_path(bucket, key)
+        entry = self.client.get_entry(path)
+        if entry is None:
+            return _err("NoSuchKey", key)
+        entry["extended"] = {
+            k: v
+            for k, v in entry.get("extended", {}).items()
+            if not k.startswith(TAG_PREFIX)
+        }
+        self.client.create_entry(path, entry)
+        return 204, b""
+
+    # -------------------------------------------------------------- multipart
+    def _initiate_multipart(self, bucket, key, headers):
+        upload_id = uuid.uuid4().hex
+        self.client.mkdir(f"{UPLOADS_DIR}/{upload_id}")
+        now = int(time.time())
+        self.client.create_entry(
+            f"{UPLOADS_DIR}/{upload_id}/.info",
+            {
+                "extended": {
+                    "bucket": bucket,
+                    "key": key,
+                    "content-type": headers.get("Content-Type", ""),
+                },
+                "mtime": now,
+                "crtime": now,
+            },
+        )
+        return 200, to_xml(
+            "InitiateMultipartUploadResult",
+            {"Bucket": bucket, "Key": key, "UploadId": upload_id},
+        )
+
+    def _upload_part(self, bucket, key, q, body, headers):
+        upload_id = q["uploadId"]
+        part = int(q["partNumber"])
+        if self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/.info") is None:
+            return _err("NoSuchUpload", upload_id)
+        if headers.get("X-Amz-Content-Sha256") == s3auth.STREAMING_PAYLOAD:
+            try:
+                body = s3auth.decode_aws_chunked(
+                    body, verify=self.iam.streaming_context(headers)
+                )
+            except s3auth.ChunkSignatureError:
+                return _err("SignatureDoesNotMatch", key)
+        r = self.client.put_object(
+            f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part", body
+        )
+        return 200, b"", {"ETag": f'"{r.get("eTag", "")}"'}
+
+    def _complete_multipart(self, bucket, key, q, body):
+        """Chunk-list concatenation, no data copy (filer_multipart.go
+        CompleteMultipartUpload)."""
+        upload_id = q["uploadId"]
+        info = self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/.info")
+        if info is None:
+            return _err("NoSuchUpload", upload_id)
+        try:
+            root = parse_xml(body)
+            part_numbers = [
+                int(find_text(p, "PartNumber")) for p in findall(root, "Part")
+            ]
+        except Exception:
+            return _err("MalformedXML", key)
+        chunks, md5_digests, offset = [], [], 0
+        for part in sorted(part_numbers):
+            pe = self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part")
+            if pe is None:
+                return _err("InvalidPart", str(part))
+            md5_digests.append(bytes.fromhex(pe.get("extended", {}).get("md5", "")))
+            for c in sorted(pe.get("chunks", []), key=lambda c: c["offset"]):
+                c = dict(c)
+                c["offset"] = offset + c["offset"]
+                chunks.append(c)
+            offset = max((c["offset"] + c["size"] for c in chunks), default=offset)
+        etag = hashlib.md5(b"".join(md5_digests)).hexdigest() + f"-{len(part_numbers)}"
+        now = int(time.time())
+        self.client.create_entry(
+            self._object_path(bucket, key),
+            {
+                "mime": info.get("extended", {}).get("content-type", ""),
+                "chunks": chunks,
+                "extended": {"md5": etag},
+                "mtime": now,
+                "crtime": now,
+            },
+        )
+        # parts not referenced by the Complete request would otherwise leak
+        # their chunks — purge them explicitly first
+        wanted = {f"{p:04d}.part" for p in part_numbers}
+        for e in self.client.list(f"{UPLOADS_DIR}/{upload_id}", limit=10001):
+            if e["name"].endswith(".part") and e["name"] not in wanted:
+                self.client.delete(f"{UPLOADS_DIR}/{upload_id}/{e['name']}")
+        # referenced parts' meta goes away; their chunks now belong to the
+        # target entry
+        self.client.delete(
+            f"{UPLOADS_DIR}/{upload_id}", recursive=True, skip_chunk_purge=True
+        )
+        return 200, to_xml(
+            "CompleteMultipartUploadResult",
+            {
+                "Location": f"/{bucket}/{key}",
+                "Bucket": bucket,
+                "Key": key,
+                "ETag": f'"{etag}"',
+            },
+        )
+
+    def _abort_multipart(self, bucket, key, q):
+        upload_id = q["uploadId"]
+        self.client.delete(f"{UPLOADS_DIR}/{upload_id}", recursive=True)
+        return 204, b""
+
+    def _list_parts(self, bucket, key, q):
+        upload_id = q["uploadId"]
+        if self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/.info") is None:
+            return _err("NoSuchUpload", upload_id)
+        parts = []
+        for e in self.client.list(f"{UPLOADS_DIR}/{upload_id}", limit=10001):
+            if not e["name"].endswith(".part"):
+                continue
+            size = max(
+                (c["offset"] + c["size"] for c in e.get("chunks", [])), default=0
+            )
+            parts.append(
+                {
+                    "PartNumber": int(e["name"].split(".")[0]),
+                    "LastModified": _iso(e.get("mtime", 0)),
+                    "ETag": f'"{e.get("extended", {}).get("md5", "")}"',
+                    "Size": size,
+                }
+            )
+        return 200, to_xml(
+            "ListPartsResult",
+            {
+                "Bucket": bucket,
+                "Key": key,
+                "UploadId": upload_id,
+                "Part": parts,
+            },
+        )
+
+    def _list_uploads(self, bucket):
+        uploads = []
+        for e in self.client.list(UPLOADS_DIR, limit=10000):
+            if not e.get("is_directory"):
+                continue
+            info = self.client.get_entry(f"{UPLOADS_DIR}/{e['name']}/.info")
+            if info and info.get("extended", {}).get("bucket") == bucket:
+                uploads.append(
+                    {
+                        "Key": info["extended"].get("key", ""),
+                        "UploadId": e["name"],
+                        "Initiated": _iso(e.get("crtime", 0)),
+                    }
+                )
+        return 200, to_xml(
+            "ListMultipartUploadsResult",
+            {"Bucket": bucket, "Upload": uploads},
+        )
+
+    # ------------------------------------------------------------------ router
+    def handle(self, method, raw_path, query, headers, body):
+        identity, err = self.iam.authenticate(
+            method, raw_path, query, headers, body
+        )
+        if err:
+            return _err(err, raw_path)
+        path = urllib.parse.unquote(raw_path)
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0] if parts[0] else ""
+        key = parts[1] if len(parts) > 1 else ""
+
+        def allowed(action):
+            return identity is None or identity.can_do(action, bucket)
+
+        if not bucket:
+            if method == "GET":
+                if not allowed(s3auth.ACTION_LIST):
+                    return _err("AccessDenied", path)
+                return self._list_buckets(identity)
+            return _err("MethodNotAllowed", path)
+
+        if not key:
+            if method == "PUT":
+                if not allowed(s3auth.ACTION_ADMIN):
+                    return _err("AccessDenied", path)
+                return self._put_bucket(bucket)
+            if method == "HEAD":
+                return self._head_bucket(bucket)
+            if method == "DELETE":
+                if not allowed(s3auth.ACTION_ADMIN):
+                    return _err("AccessDenied", path)
+                return self._delete_bucket(bucket)
+            if method == "POST" and "delete" in query:
+                if not allowed(s3auth.ACTION_WRITE):
+                    return _err("AccessDenied", path)
+                return self._delete_multiple(bucket, body)
+            if method == "GET":
+                if not allowed(s3auth.ACTION_LIST):
+                    return _err("AccessDenied", path)
+                if "uploads" in query:
+                    return self._list_uploads(bucket)
+                if "location" in query:
+                    return 200, to_xml("LocationConstraint", "")
+                return self._list_objects(
+                    bucket, query, v2=query.get("list-type") == "2"
+                )
+            return _err("MethodNotAllowed", path)
+
+        # object-level
+        if "tagging" in query:
+            if not allowed(s3auth.ACTION_TAGGING):
+                return _err("AccessDenied", path)
+            if method == "GET":
+                return self._get_tagging(bucket, key)
+            if method == "PUT":
+                return self._put_tagging(bucket, key, body)
+            if method == "DELETE":
+                return self._delete_tagging(bucket, key)
+        if method == "POST" and "uploads" in query:
+            if not allowed(s3auth.ACTION_WRITE):
+                return _err("AccessDenied", path)
+            return self._initiate_multipart(bucket, key, headers)
+        if method == "POST" and "uploadId" in query:
+            if not allowed(s3auth.ACTION_WRITE):
+                return _err("AccessDenied", path)
+            return self._complete_multipart(bucket, key, query, body)
+        if method == "PUT" and "uploadId" in query:
+            if not allowed(s3auth.ACTION_WRITE):
+                return _err("AccessDenied", path)
+            return self._upload_part(bucket, key, query, body, headers)
+        if method == "DELETE" and "uploadId" in query:
+            if not allowed(s3auth.ACTION_WRITE):
+                return _err("AccessDenied", path)
+            return self._abort_multipart(bucket, key, query)
+        if method == "GET" and "uploadId" in query:
+            return self._list_parts(bucket, key, query)
+        if method == "PUT":
+            if not allowed(s3auth.ACTION_WRITE):
+                return _err("AccessDenied", path)
+            return self._put_object(bucket, key, headers, body)
+        if method in ("GET", "HEAD"):
+            if not allowed(s3auth.ACTION_READ):
+                return _err("AccessDenied", path)
+            return self._get_object(bucket, key, headers, head=(method == "HEAD"))
+        if method == "DELETE":
+            if not allowed(s3auth.ACTION_WRITE):
+                return _err("AccessDenied", path)
+            return self._delete_object(bucket, key)
+        return _err("MethodNotAllowed", path)
+
+    # --------------------------------------------------------------- lifecycle
+    def start(self):
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _go(self, method):
+                parsed = urllib.parse.urlparse(self.path)
+                query = {
+                    k: v[0]
+                    for k, v in urllib.parse.parse_qs(
+                        parsed.query, keep_blank_values=True
+                    ).items()
+                }
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                headers = {k.title(): v for k, v in self.headers.items()}
+                try:
+                    result = api.handle(method, parsed.path, query, headers, body)
+                except Exception as e:  # noqa: BLE001
+                    result = 500, error_xml("InternalError", str(e), parsed.path)
+                if len(result) == 2:
+                    status, payload = result
+                    extra = {}
+                else:
+                    status, payload, extra = result
+                self.send_response(status)
+                clen = extra.pop("Content-Length-Override", None)
+                ctype = extra.pop(
+                    "Content-Type",
+                    "application/xml" if payload else "application/octet-stream",
+                )
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", clen or str(len(payload)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if method != "HEAD" and payload:
+                    self.wfile.write(payload)
+
+            def do_GET(self):
+                self._go("GET")
+
+            def do_PUT(self):
+                self._go("PUT")
+
+            def do_POST(self):
+                self._go("POST")
+
+            def do_DELETE(self):
+                self._go("DELETE")
+
+            def do_HEAD(self):
+                self._go("HEAD")
+
+        self._srv = start_server(Handler, self.host, self.port)
+        return self
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+def _err(code: str, resource: str):
+    status = _ERR_STATUS.get(code, 400)
+    return status, error_xml(code, code, resource)

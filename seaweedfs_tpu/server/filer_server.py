@@ -9,12 +9,22 @@ Mirrors `weed/server/filer_server_handlers_*.go`:
                       Range supported; directory: JSON listing (_read_dir.go)
     HEAD /path      — meta only
     DELETE /path[?recursive=true]
+
+Metadata-level endpoints standing in for the filer gRPC rpcs
+(`pb/filer.proto` LookupDirectoryEntry/CreateEntry/AtomicRenameEntry) that
+the S3 gateway and replication layers build on:
+    GET    /path?meta=true            — full entry JSON incl. chunk list
+    POST   /path?meta=true            — create entry from JSON body
+    POST   /path?mv.to=/new/path      — atomic rename
+    DELETE /path?skipChunkPurge=true  — drop meta, keep chunks (multipart)
+    GET    /dir/?prefix=x&meta=true   — listing with name-prefix filter
 Deleted/overwritten chunk fids are purged from the object store
 (filer_deletion.go → operation.DeleteFiles).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import threading
 import time
@@ -60,7 +70,21 @@ class FilerServer:
     # -- write path (auto-chunking) ------------------------------------------
     def _h_write(self, h, path, q, body):
         path = urllib.parse.unquote(path)
+        if q.get("mv.to"):
+            entry = self.filer.rename(path.rstrip("/") or "/", q["mv.to"])
+            return 200, {"name": entry.name, "path": entry.full_path}
+        if q.get("meta") == "true":
+            d = json.loads(body)
+            d["full_path"] = path.rstrip("/") or "/"
+            entry = self.filer.create_entry(Entry.from_dict(d))
+            return 201, {"name": entry.name}
         if path.endswith("/"):
+            if q.get("mkdir") == "true":
+                entry = Entry(
+                    full_path=path.rstrip("/") or "/", is_directory=True, mode=0o775
+                )
+                self.filer.create_entry(entry)
+                return 201, {"name": entry.name}
             return 400, {"error": "cannot write to a directory path"}
         collection = q.get("collection", self.collection)
         replication = q.get("replication", self.replication)
@@ -87,15 +111,29 @@ class FilerServer:
                 )
             )
             offset += len(piece)
+        # header names arrive case-mangled (urllib capitalizes); Title-Case
+        # them so readers can filter with a canonical prefix
+        extended = {
+            k[len("Seaweed-") :].title(): v
+            for k, v in h.headers.items()
+            if k.title().startswith("Seaweed-")
+        }
+        extended["md5"] = hashlib.md5(body).hexdigest()
         entry = Entry(
             full_path=path,
             mime=h.headers.get("Content-Type", "") or "",
             collection=collection,
             replication=replication,
             chunks=chunks,
+            extended=extended,
         )
         self.filer.create_entry(entry)
-        return 201, {"name": entry.name, "size": len(body), "chunks": len(chunks)}
+        return 201, {
+            "name": entry.name,
+            "size": len(body),
+            "chunks": len(chunks),
+            "eTag": extended["md5"],
+        }
 
     # -- read path ------------------------------------------------------------
     def _h_read(self, h, path, q, body):
@@ -105,21 +143,44 @@ class FilerServer:
             entry = self.filer.find_entry(lookup)
         except NotFoundError:
             return 404, {"error": f"{path} not found"}
+        # meta=true returns the raw entry (works for dirs too, unless the
+        # trailing slash asks for a listing) — LookupDirectoryEntry analog
+        if q.get("meta") == "true" and not (
+            entry.is_directory and path.endswith("/")
+        ):
+            return 200, entry.to_dict()
         if entry.is_directory:
             limit = int(q.get("limit", 1000))
-            entries = [
-                {
-                    "name": e.name,
-                    "is_directory": e.is_directory,
-                    "size": e.file_size(),
-                    "mtime": e.mtime,
-                    "mime": e.mime,
-                }
-                for e in self.filer.list_entries(
-                    lookup, q.get("lastFileName", ""), limit
-                )
-            ]
-            return 200, {"path": lookup, "entries": entries}
+            prefix = q.get("prefix", "")
+            full_meta = q.get("meta") == "true"
+            entries = []
+            # page through the store so a name-prefix filter can't starve the
+            # result when non-matching names fill the first page
+            cursor = q.get("lastFileName", "")
+            while len(entries) < limit:
+                page = list(self.filer.list_entries(lookup, cursor, limit))
+                if not page:
+                    break
+                for e in page:
+                    cursor = e.name
+                    if prefix and not e.name.startswith(prefix):
+                        continue
+                    entries.append(
+                        e.to_dict() | {"name": e.name}
+                        if full_meta
+                        else {
+                            "name": e.name,
+                            "is_directory": e.is_directory,
+                            "size": e.file_size(),
+                            "mtime": e.mtime,
+                            "mime": e.mime,
+                        }
+                    )
+                    if len(entries) >= limit:
+                        break
+                if len(page) < limit:
+                    break
+            return 200, {"path": lookup, "entries": entries, "lastFileName": cursor}
         total = entry.file_size()
         offset, size = 0, total
         rng = h.headers.get("Range", "")
@@ -190,6 +251,7 @@ class FilerServer:
                 path,
                 recursive=q.get("recursive") == "true",
                 ignore_recursive_error=q.get("ignoreRecursiveError") == "true",
+                skip_chunk_purge=q.get("skipChunkPurge") == "true",
             )
         except NotFoundError:
             return 404, {"error": f"{path} not found"}
