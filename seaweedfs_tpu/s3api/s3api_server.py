@@ -23,7 +23,7 @@ from http.server import BaseHTTPRequestHandler
 from ..server.http_util import start_server
 from . import auth as s3auth
 from .auth import IAM
-from .filer_client import FilerClient
+from ..filer.client import FilerClient
 from .xml_util import error_xml, find_text, findall, parse_xml, to_xml
 
 BUCKETS_DIR = "/buckets"

@@ -1,0 +1,292 @@
+"""WebDAV gateway over the filer (reference: `weed/server/webdav_server.go:41`,
+which adapts `golang.org/x/net/webdav` onto the filer gRPC client).
+
+Implements the class-1 WebDAV method set — OPTIONS, PROPFIND (Depth 0/1),
+MKCOL, GET/HEAD/PUT/DELETE, MOVE, COPY — as a stdlib HTTP server speaking
+multistatus XML, backed by the filer HTTP surface via FilerClient.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+import xml.etree.ElementTree as ET
+from datetime import datetime, timezone
+from http.server import BaseHTTPRequestHandler
+
+from ..filer.client import FilerClient
+from .http_util import start_server
+
+DAV_NS = "DAV:"
+
+
+def _rfc1123(ts: float) -> str:
+    return datetime.fromtimestamp(ts, tz=timezone.utc).strftime(
+        "%a, %d %b %Y %H:%M:%S GMT"
+    )
+
+
+def _iso(ts: float) -> str:
+    return datetime.fromtimestamp(ts, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def _propstat(href: str, entry: dict) -> ET.Element:
+    resp = ET.Element("{DAV:}response")
+    ET.SubElement(resp, "{DAV:}href").text = urllib.parse.quote(href)
+    propstat = ET.SubElement(resp, "{DAV:}propstat")
+    prop = ET.SubElement(propstat, "{DAV:}prop")
+    is_dir = entry.get("is_directory", False)
+    rtype = ET.SubElement(prop, "{DAV:}resourcetype")
+    if is_dir:
+        ET.SubElement(rtype, "{DAV:}collection")
+    else:
+        size = max(
+            (c["offset"] + c["size"] for c in entry.get("chunks", [])), default=0
+        )
+        ET.SubElement(prop, "{DAV:}getcontentlength").text = str(size)
+        ET.SubElement(prop, "{DAV:}getcontenttype").text = (
+            entry.get("mime") or "application/octet-stream"
+        )
+        ET.SubElement(prop, "{DAV:}getetag").text = (
+            '"%s"' % entry.get("extended", {}).get("md5", "")
+        )
+    ET.SubElement(prop, "{DAV:}getlastmodified").text = _rfc1123(
+        entry.get("mtime", 0)
+    )
+    ET.SubElement(prop, "{DAV:}creationdate").text = _iso(entry.get("crtime", 0))
+    ET.SubElement(prop, "{DAV:}displayname").text = entry.get("name", "")
+    ET.SubElement(propstat, "{DAV:}status").text = "HTTP/1.1 200 OK"
+    return resp
+
+
+class WebDavServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 7333,
+        filer_url: str = "127.0.0.1:8888",
+        root: str = "/",
+    ):
+        self.host, self.port = host, port
+        self.client = FilerClient(filer_url)
+        self.root = root.rstrip("/")
+        self._srv = None
+
+    def _fp(self, dav_path: str) -> str:
+        """DAV path → filer path under the configured root."""
+        p = urllib.parse.unquote(dav_path)
+        return (self.root + "/" + p.strip("/")).rstrip("/") or "/"
+
+    # ---------------------------------------------------------------- methods
+    def do_options(self, path, headers, body):
+        return 200, b"", {
+            "DAV": "1,2",
+            "Allow": "OPTIONS, PROPFIND, MKCOL, GET, HEAD, PUT, DELETE, MOVE, COPY",
+            "MS-Author-Via": "DAV",
+        }
+
+    def do_propfind(self, path, headers, body):
+        depth = headers.get("Depth", "1")
+        fp = self._fp(path)
+        entry = self.client.get_entry(fp)
+        if entry is None:
+            return 404, b"", {}
+        entry["name"] = fp.rsplit("/", 1)[-1]
+        ms = ET.Element("{DAV:}multistatus")
+        href = "/" + path.strip("/")
+        if entry.get("is_directory") and not href.endswith("/"):
+            href += "/"
+        ms.append(_propstat(href or "/", entry))
+        if depth != "0" and entry.get("is_directory"):
+            for child in self.client.list(fp, limit=10000):
+                chref = href.rstrip("/") + "/" + child["name"]
+                if child.get("is_directory"):
+                    chref += "/"
+                ms.append(_propstat(chref, child))
+        ET.register_namespace("D", DAV_NS)
+        out = b'<?xml version="1.0" encoding="utf-8"?>' + ET.tostring(ms)
+        return 207, out, {"Content-Type": 'text/xml; charset="utf-8"'}
+
+    def do_mkcol(self, path, headers, body):
+        fp = self._fp(path)
+        if self.client.get_entry(fp) is not None:
+            return 405, b"", {}
+        parent = fp.rsplit("/", 1)[0] or "/"
+        if parent != "/" and self.client.get_entry(parent) is None:
+            return 409, b"", {}  # RFC: intermediate collections must exist
+        self.client.mkdir(fp)
+        return 201, b"", {}
+
+    def do_get(self, path, headers, body, head=False):
+        fp = self._fp(path)
+        entry = self.client.get_entry(fp)
+        if entry is None:
+            return 404, b"", {}
+        if entry.get("is_directory"):
+            return 405, b"", {}
+        extra = {
+            "Content-Type": entry.get("mime") or "application/octet-stream",
+            "Last-Modified": _rfc1123(entry.get("mtime", 0)),
+            "ETag": '"%s"' % entry.get("extended", {}).get("md5", ""),
+        }
+        if head:
+            size = max(
+                (c["offset"] + c["size"] for c in entry.get("chunks", [])),
+                default=0,
+            )
+            extra["Content-Length-Override"] = str(size)
+            return 200, b"", extra
+        status, data, h = self.client.get_object(fp, rng=headers.get("Range"))
+        if status == 206 and "Content-Range" in h:
+            extra["Content-Range"] = h["Content-Range"]
+        return status, data, extra
+
+    def do_put(self, path, headers, body):
+        fp = self._fp(path)
+        existing = self.client.get_entry(fp)
+        if existing is not None and existing.get("is_directory"):
+            return 405, b"", {}
+        self.client.put_object(
+            fp, body, content_type=headers.get("Content-Type", "")
+        )
+        return 201 if existing is None else 204, b"", {}
+
+    def do_delete(self, path, headers, body):
+        fp = self._fp(path)
+        if self.client.get_entry(fp) is None:
+            return 404, b"", {}
+        self.client.delete(fp, recursive=True)
+        return 204, b"", {}
+
+    def _dest(self, headers) -> str | None:
+        dest = headers.get("Destination", "")
+        if not dest:
+            return None
+        return urllib.parse.urlparse(dest).path
+
+    def do_move(self, path, headers, body):
+        dest = self._dest(headers)
+        if dest is None:
+            return 400, b"", {}
+        src_fp, dst_fp = self._fp(path), self._fp(dest)
+        if self.client.get_entry(src_fp) is None:
+            return 404, b"", {}
+        overwrite = headers.get("Overwrite", "T") != "F"
+        existed = self.client.get_entry(dst_fp) is not None
+        if existed and not overwrite:
+            return 412, b"", {}
+        if existed:
+            self.client.delete(dst_fp, recursive=True)
+        self.client.rename(src_fp, dst_fp)
+        return 204 if existed else 201, b"", {}
+
+    def do_copy(self, path, headers, body):
+        dest = self._dest(headers)
+        if dest is None:
+            return 400, b"", {}
+        src_fp, dst_fp = self._fp(path), self._fp(dest)
+        entry = self.client.get_entry(src_fp)
+        if entry is None:
+            return 404, b"", {}
+        overwrite = headers.get("Overwrite", "T") != "F"
+        existed = self.client.get_entry(dst_fp) is not None
+        if existed and not overwrite:
+            return 412, b"", {}
+        if entry.get("is_directory"):
+            self.client.mkdir(dst_fp)
+            for child in self.client.list(src_fp, limit=10000):
+                self.do_copy(
+                    path.rstrip("/") + "/" + child["name"],
+                    {
+                        "Destination": dest.rstrip("/") + "/" + child["name"],
+                        "Overwrite": "T",
+                    },
+                    b"",
+                )
+        else:
+            status, data, _ = self.client.get_object(src_fp)
+            if status != 200:
+                return 404, b"", {}
+            self.client.put_object(dst_fp, data, content_type=entry.get("mime", ""))
+        return 204 if existed else 201, b"", {}
+
+    # --------------------------------------------------------------- lifecycle
+    def start(self):
+        dav = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _go(self, method):
+                parsed = urllib.parse.urlparse(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                headers = {k.title(): v for k, v in self.headers.items()}
+                if method == "HEAD":
+                    fn = lambda p, h, b: dav.do_get(p, h, b, head=True)  # noqa: E731
+                else:
+                    fn = getattr(dav, f"do_{method.lower()}", None)
+                if fn is None:
+                    status, payload, extra = 405, b"", {}
+                else:
+                    try:
+                        status, payload, extra = fn(parsed.path, headers, body)
+                    except Exception as e:  # noqa: BLE001
+                        status, payload, extra = 500, str(e).encode(), {}
+                self.send_response(status)
+                clen = extra.pop("Content-Length-Override", None)
+                if "Content-Type" not in extra and payload:
+                    extra["Content-Type"] = "application/octet-stream"
+                self.send_header("Content-Length", clen or str(len(payload)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if method != "HEAD" and payload:
+                    self.wfile.write(payload)
+
+            def do_OPTIONS(self):
+                self._go("OPTIONS")
+
+            def do_PROPFIND(self):
+                self._go("PROPFIND")
+
+            def do_MKCOL(self):
+                self._go("MKCOL")
+
+            def do_GET(self):
+                self._go("GET")
+
+            def do_HEAD(self):
+                self._go("HEAD")
+
+            def do_PUT(self):
+                self._go("PUT")
+
+            def do_DELETE(self):
+                self._go("DELETE")
+
+            def do_MOVE(self):
+                self._go("MOVE")
+
+            def do_COPY(self):
+                self._go("COPY")
+
+            def do_PROPPATCH(self):
+                # accepted but ignored (live props are computed)
+                self._go("PROPFIND")
+
+        self._srv = start_server(Handler, self.host, self.port)
+        return self
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
