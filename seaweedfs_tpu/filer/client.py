@@ -30,9 +30,12 @@ class FilerClient:
         body: bytes,
         content_type: str = "",
         extended: Optional[dict] = None,
+        signatures: Optional[list[int]] = None,
     ) -> dict:
         req = urllib.request.Request(
-            self._u(path), data=body, method="PUT"
+            self._u(path, sig=",".join(map(str, signatures or []))),
+            data=body,
+            method="PUT",
         )
         if content_type:
             req.add_header("Content-Type", content_type)
@@ -60,8 +63,16 @@ class FilerClient:
             return None
         return json.loads(body)
 
-    def create_entry(self, path: str, entry: dict) -> None:
-        http_json("POST", self._u(path, meta="true"), body=entry)
+    def create_entry(
+        self, path: str, entry: dict, signatures: Optional[list[int]] = None
+    ) -> None:
+        http_json(
+            "POST",
+            self._u(
+                path, meta="true", sig=",".join(map(str, signatures or []))
+            ),
+            body=entry,
+        )
 
     def mkdir(self, path: str) -> None:
         http_json("POST", self._u(path.rstrip("/") + "/", mkdir="true"))
@@ -71,6 +82,7 @@ class FilerClient:
         path: str,
         recursive: bool = False,
         skip_chunk_purge: bool = False,
+        signatures: Optional[list[int]] = None,
     ) -> int:
         status, _ = http_bytes(
             "DELETE",
@@ -79,6 +91,7 @@ class FilerClient:
                 recursive="true" if recursive else "",
                 ignoreRecursiveError="true" if recursive else "",
                 skipChunkPurge="true" if skip_chunk_purge else "",
+                sig=",".join(map(str, signatures or [])),
             ),
         )
         return status
@@ -106,3 +119,22 @@ class FilerClient:
 
     def rename(self, old: str, new: str) -> None:
         http_json("POST", self._u(old, **{"mv.to": new}))
+
+    # -- meta subscribe / kv / status ----------------------------------------
+    def status(self) -> dict:
+        return http_json("GET", self.base + "/_status")
+
+    def meta_events(self, since_ns: int = 0, limit: int = 1000) -> dict:
+        return http_json(
+            "GET",
+            self.base + f"/_meta/events?since_ns={since_ns}&limit={limit}",
+        )
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        http_bytes("PUT", self.base + "/_kv/" + urllib.parse.quote(key), value)
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        status, body = http_bytes(
+            "GET", self.base + "/_kv/" + urllib.parse.quote(key)
+        )
+        return body if status == 200 else None

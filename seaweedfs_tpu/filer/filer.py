@@ -41,7 +41,12 @@ class Filer:
             )
 
     # -- CRUD (filer.go:131-253) ---------------------------------------------
-    def create_entry(self, entry: Entry, o_excl: bool = False) -> Entry:
+    def create_entry(
+        self,
+        entry: Entry,
+        o_excl: bool = False,
+        signatures: Optional[list[int]] = None,
+    ) -> Entry:
         with self._lock:
             self._ensure_parents(entry.parent)
             old = None
@@ -59,6 +64,7 @@ class Filer:
             entry.parent,
             old.to_dict() if old else None,
             entry.to_dict(),
+            signatures=signatures,
         )
         # chunks shadowed by the overwrite become garbage
         if old is not None and old.chunks and self.chunk_purger:
@@ -114,19 +120,26 @@ class Filer:
         recursive: bool = False,
         ignore_recursive_error: bool = False,
         skip_chunk_purge: bool = False,
+        signatures: Optional[list[int]] = None,
     ) -> list[str]:
         """Returns the chunk fids queued for purging
         (filer_delete_entry.go:15). Chunks are purged once, at the top level.
         `skip_chunk_purge` drops the metadata but keeps the chunks — used when
         chunk ownership moved to another entry (S3 multipart complete,
         filer_multipart.go)."""
-        fids = self._delete_entry(path, recursive, ignore_recursive_error)
+        fids = self._delete_entry(
+            path, recursive, ignore_recursive_error, signatures
+        )
         if fids and self.chunk_purger and not skip_chunk_purge:
             self.chunk_purger(fids)
         return fids
 
     def _delete_entry(
-        self, path: str, recursive: bool, ignore_recursive_error: bool
+        self,
+        path: str,
+        recursive: bool,
+        ignore_recursive_error: bool,
+        signatures: Optional[list[int]] = None,
     ) -> list[str]:
         entry = self.store.find_entry(path)
         fids: list[str] = []
@@ -138,7 +151,9 @@ class Filer:
                 for child in children:
                     try:
                         fids.extend(
-                            self._delete_entry(child.full_path, True, ignore_recursive_error)
+                            self._delete_entry(
+                                child.full_path, True, ignore_recursive_error, signatures
+                            )
                         )
                     except Exception:
                         if not ignore_recursive_error:
@@ -146,7 +161,11 @@ class Filer:
             fids.extend(c.file_id for c in entry.chunks)
             self.store.delete_entry(path)
         self.meta_log.append(
-            entry.parent, entry.to_dict(), None, delete_chunks=bool(fids)
+            entry.parent,
+            entry.to_dict(),
+            None,
+            delete_chunks=bool(fids),
+            signatures=signatures,
         )
         return fids
 

@@ -1,0 +1,18 @@
+"""Cross-cluster replication (reference: `weed/replication/`,
+`weed/notification/`, `weed/command/filer_sync.go`).
+
+- `sink`: ReplicationSink implementations — another filer cluster, an
+  S3-compatible endpoint, or a local directory (stand-in for the
+  GCS/Azure/B2 cloud sinks, which differ only in SDK plumbing).
+- `replicator`: maps filer meta events (create/update/delete) to sink calls.
+- `notification`: pluggable queues publishing filer meta events
+  (in-memory + JSONL file queue standing in for kafka/sqs/pubsub).
+- `filer_sync`: continuous active-active or active-passive sync between two
+  filer clusters with signature-based loop prevention and offsets
+  checkpointed in the target filer's KV store.
+"""
+
+from .replicator import Replicator  # noqa: F401
+from .sink import FilerSink, LocalFsSink, S3Sink  # noqa: F401
+from .filer_sync import FilerSync  # noqa: F401
+from .notification import FileQueue, MemoryQueue, NotificationBus  # noqa: F401

@@ -1,0 +1,93 @@
+"""Continuous filer→filer cluster sync (reference `command/filer_sync.go:81`).
+
+One `FilerSync` replicates source→target; run two (swapped) for
+active-active. Loop prevention (`filer_sync.go:116`): writes to the target
+carry the SOURCE filer's signature, so events they generate on the target
+are recognized by the reverse syncer (exclude_signature = its own source's
+signature) and skipped. Progress is checkpointed in the TARGET filer's KV
+store (`setOffset/getOffset`), so a restarted syncer resumes where it left.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..filer.client import FilerClient
+from .replicator import Replicator
+from .sink import FilerSink
+
+
+class FilerSync:
+    def __init__(
+        self,
+        source_url: str,
+        target_url: str,
+        source_path: str = "/",
+        target_path: str = "",
+        poll_interval: float = 0.2,
+    ):
+        self.source = FilerClient(source_url)
+        self.target = FilerClient(target_url)
+        self.source_url = source_url
+        src_sig = self.source.status().get("signature", 0)
+        tgt_sig = self.target.status().get("signature", 0)
+        sink = FilerSink(
+            target_url, path_prefix=target_path, signatures=[src_sig]
+        )
+        self.replicator = Replicator(
+            sink,
+            read_content=self._read_source,
+            source_path=source_path,
+            # events that already carry the target's signature came FROM the
+            # target via the reverse syncer — do not bounce them back
+            exclude_signature=tgt_sig,
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.poll_interval = poll_interval
+
+    # offset checkpointing in the target's KV (filer_sync.go getOffset)
+    @property
+    def _offset_key(self) -> str:
+        return f"sync.offset.{self.source_url}"
+
+    def _get_offset(self) -> int:
+        v = self.target.kv_get(self._offset_key)
+        return int(v) if v else 0
+
+    def _set_offset(self, ts_ns: int) -> None:
+        self.target.kv_put(self._offset_key, str(ts_ns).encode())
+
+    def _read_source(self, path: str) -> bytes | None:
+        status, data, _ = self.source.get_object(path)
+        return data if status == 200 else None
+
+    def sync_once(self, limit: int = 1000) -> int:
+        """One poll cycle; returns number of events processed."""
+        since = self._get_offset()
+        resp = self.source.meta_events(since_ns=since, limit=limit)
+        events = resp.get("events", [])
+        for ev in events:
+            try:
+                self.replicator.replicate(ev)
+            except Exception:
+                pass  # keep the stream moving; next full-sync repairs
+            self._set_offset(ev["ts_ns"])
+        return len(events)
+
+    def run_forever(self) -> None:
+        while not self._stop.is_set():
+            n = self.sync_once()
+            if n == 0:
+                self._stop.wait(self.poll_interval)
+
+    def start(self) -> "FilerSync":
+        self._thread = threading.Thread(target=self.run_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,97 @@
+"""Notification bus: publish filer meta events to pluggable queues.
+
+Reference: `weed/notification/configuration.go` (`Queues` registry) with
+kafka / aws_sqs / google_pub_sub / gocdk backends. Here: an in-memory queue
+(for in-process consumers/tests) and a JSONL file queue (durable hand-off to
+external consumers) — the cloud backends differ only in SDK plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Optional
+
+
+class MessageQueue:
+    def send(self, key: str, message: dict) -> None:
+        raise NotImplementedError
+
+
+class MemoryQueue(MessageQueue):
+    def __init__(self, maxsize: int = 10000):
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+
+    def send(self, key, message):
+        self.q.put((key, message))
+
+    def receive(self, timeout: float = 1.0) -> Optional[tuple[str, dict]]:
+        try:
+            return self.q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class FileQueue(MessageQueue):
+    """Append-only JSONL event log."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def send(self, key, message):
+        line = json.dumps({"key": key, "message": message})
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")
+
+    def read_all(self) -> list[dict]:
+        try:
+            with open(self.path) as f:
+                return [json.loads(ln) for ln in f if ln.strip()]
+        except FileNotFoundError:
+            return []
+
+
+class NotificationBus:
+    """Attaches queues to a filer's meta log (filer_notify.go
+    NotifyUpdateEvent → notification.Queue.SendMessage)."""
+
+    def __init__(self, filer, prefix: str = "/"):
+        self.filer = filer
+        self.prefix = prefix
+        self.queues: list[MessageQueue] = []
+        self._attached = False
+
+    def add_queue(self, q: MessageQueue) -> "NotificationBus":
+        self.queues.append(q)
+        if not self._attached:
+            self.filer.meta_log.subscribe(f"notify-{id(self)}", self._on_event)
+            self._attached = True
+        return self
+
+    def _on_event(self, ev) -> None:
+        path = None
+        if ev.new_entry:
+            path = ev.new_entry.get("full_path")
+        elif ev.old_entry:
+            path = ev.old_entry.get("full_path")
+        if path is None or not path.startswith(self.prefix):
+            return
+        msg = {
+            "ts_ns": ev.ts_ns,
+            "directory": ev.directory,
+            "old_entry": ev.old_entry,
+            "new_entry": ev.new_entry,
+            "delete_chunks": ev.delete_chunks,
+        }
+        for q in self.queues:
+            try:
+                q.send(path, msg)
+            except Exception:
+                pass  # a stuck queue must not block filer mutations
+
+    def detach(self) -> None:
+        if self._attached:
+            self.filer.meta_log.unsubscribe(f"notify-{id(self)}")
+            self._attached = False

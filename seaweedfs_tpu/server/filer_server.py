@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import random
 import threading
 import time
 import urllib.parse
@@ -60,12 +61,57 @@ class FilerServer:
         )
         self._lookup = operation.LookupCache(master_url)
         self._srv = None
+        # cluster-sync loop-prevention signature (filer.go Signature)
+        self.signature = random.getrandbits(31)
 
     def _purge_chunks(self, fids: list[str]) -> None:
         t = threading.Thread(
             target=operation.delete_files, args=(self.master_url, fids), daemon=True
         )
         t.start()
+
+    # -- meta subscribe / kv / status (filer_pb rpc analogs) -----------------
+    def _h_meta_events(self, h, path, q, body):
+        """SubscribeMetadata analog: poll events after since_ns
+        (server/filer_grpc_server_sub_meta.go)."""
+        since = int(q.get("since_ns", 0))
+        limit = int(q.get("limit", 1000))
+        events = self.filer.meta_log.replay_since(since)[:limit]
+        out = [
+            {
+                "ts_ns": e.ts_ns,
+                "directory": e.directory,
+                "old_entry": e.old_entry,
+                "new_entry": e.new_entry,
+                "delete_chunks": e.delete_chunks,
+                "signatures": e.signatures,
+            }
+            for e in events
+        ]
+        last = out[-1]["ts_ns"] if out else since
+        return 200, {"events": out, "last_ts_ns": last}
+
+    def _h_kv(self, h, path, q, body):
+        key = path[len("/_kv/") :].encode()
+        if h.command == "PUT" or h.command == "POST":
+            self.filer.store.kv_put(key, body)
+            return 200, {"ok": True}
+        v = self.filer.store.kv_get(key)
+        if v is None:
+            return 404, {"error": "not found"}
+        return 200, v
+
+    def _h_status(self, h, path, q, body):
+        return 200, {
+            "signature": self.signature,
+            "url": self.url,
+            "master": self.master_url,
+        }
+
+    @staticmethod
+    def _sigs(q) -> Optional[list[int]]:
+        raw = q.get("sig", "")
+        return [int(x) for x in raw.split(",") if x] or None
 
     # -- write path (auto-chunking) ------------------------------------------
     def _h_write(self, h, path, q, body):
@@ -76,7 +122,9 @@ class FilerServer:
         if q.get("meta") == "true":
             d = json.loads(body)
             d["full_path"] = path.rstrip("/") or "/"
-            entry = self.filer.create_entry(Entry.from_dict(d))
+            entry = self.filer.create_entry(
+                Entry.from_dict(d), signatures=self._sigs(q)
+            )
             return 201, {"name": entry.name}
         if path.endswith("/"):
             if q.get("mkdir") == "true":
@@ -127,7 +175,7 @@ class FilerServer:
             chunks=chunks,
             extended=extended,
         )
-        self.filer.create_entry(entry)
+        self.filer.create_entry(entry, signatures=self._sigs(q))
         return 201, {
             "name": entry.name,
             "size": len(body),
@@ -252,6 +300,7 @@ class FilerServer:
                 recursive=q.get("recursive") == "true",
                 ignore_recursive_error=q.get("ignoreRecursiveError") == "true",
                 skip_chunk_purge=q.get("skipChunkPurge") == "true",
+                signatures=self._sigs(q),
             )
         except NotFoundError:
             return 404, {"error": f"{path} not found"}
@@ -266,6 +315,11 @@ class FilerServer:
 
         class Handler(JsonHandler):
             routes = [
+                ("GET", "/_meta/events", fs._h_meta_events),
+                ("GET", "/_status", fs._h_status),
+                ("GET", "/_kv/", fs._h_kv),
+                ("PUT", "/_kv/", fs._h_kv),
+                ("POST", "/_kv/", fs._h_kv),
                 ("GET", "/", fs._h_read),
                 ("HEAD", "/", fs._h_head),
                 ("POST", "/", fs._h_write),
