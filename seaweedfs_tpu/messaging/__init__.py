@@ -1,0 +1,13 @@
+"""Pub/sub message broker on the filer (reference: `weed/messaging/`).
+
+Kafka-lite: topics/partitions are filer directories under
+`/topics/<namespace>/<topic>/<partition>`; published messages append to an
+in-memory log buffer flushed as segment files; subscribers replay persisted
+segments then tail the live buffer; partition→broker placement uses a
+consistent-hash ring (`consistent_distribution.go`).
+"""
+
+from .broker import Broker, TopicManager  # noqa: F401
+from .client import MessagingClient  # noqa: F401
+from .consistent import ConsistentRing  # noqa: F401
+from .log_buffer import LogBuffer  # noqa: F401

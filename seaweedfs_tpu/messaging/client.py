@@ -1,0 +1,111 @@
+"""Messaging client library (reference `messaging/msgclient/`): publisher
+with consistent-hash partition→broker routing, poll-based subscriber."""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Iterator, Optional
+
+from ..server.http_util import http_bytes, http_json
+from .consistent import ConsistentRing
+
+
+class MessagingClient:
+    def __init__(self, brokers: list[str]):
+        self.brokers = brokers
+        self.ring = ConsistentRing()
+        for b in brokers:
+            self.ring.add(b)
+
+    def _broker_for(self, ns: str, topic: str, partition: int) -> str:
+        return self.ring.get(f"{ns}/{topic}/{partition:02d}")
+
+    # -- topic admin ---------------------------------------------------------
+    def create_topic(self, ns: str, topic: str, partitions: int = 4) -> dict:
+        b = self.brokers[0]
+        return http_json(
+            "POST",
+            f"http://{b}/topics/{ns}/{topic}?partitions={partitions}",
+        )
+
+    def topic_conf(self, ns: str, topic: str) -> dict:
+        return http_json("GET", f"http://{self.brokers[0]}/topics/{ns}/{topic}")
+
+    # -- publish -------------------------------------------------------------
+    def publish(
+        self,
+        ns: str,
+        topic: str,
+        value: bytes,
+        key: bytes = b"",
+        partition: Optional[int] = None,
+    ) -> int:
+        conf = self.topic_conf(ns, topic)
+        n = conf.get("partitions", 1)
+        if partition is None:
+            partition = (hash(key) if key else time.monotonic_ns()) % n
+        broker = self._broker_for(ns, topic, partition)
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{broker}/pub/{ns}/{topic}/{partition}",
+            data=value,
+            method="POST",
+        )
+        if key:
+            req.add_header("X-Msg-Key", base64.b64encode(key).decode())
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            import json
+
+            return json.loads(resp.read())["ts_ns"]
+
+    # -- subscribe -----------------------------------------------------------
+    def fetch(
+        self, ns: str, topic: str, partition: int, since_ns: int = 0,
+        limit: int = 1000,
+    ) -> tuple[list[dict], int]:
+        broker = self._broker_for(ns, topic, partition)
+        status, body = http_bytes(
+            "GET",
+            f"http://{broker}/sub/{ns}/{topic}/{partition}"
+            f"?since_ns={since_ns}&limit={limit}",
+        )
+        import json
+
+        d = json.loads(body)
+        msgs = [
+            {
+                "ts_ns": m["ts_ns"],
+                "key": base64.b64decode(m["key"]),
+                "value": base64.b64decode(m["value"]),
+            }
+            for m in d.get("messages", [])
+        ]
+        return msgs, d.get("last_ts_ns", since_ns)
+
+    def subscribe(
+        self,
+        ns: str,
+        topic: str,
+        partition: int,
+        since_ns: int = 0,
+        poll_interval: float = 0.1,
+        stop_after_idle: Optional[float] = None,
+    ) -> Iterator[dict]:
+        """Replay from since_ns then tail. Yields message dicts; stops after
+        `stop_after_idle` seconds without new messages (None = forever)."""
+        offset = since_ns
+        idle_since = time.monotonic()
+        while True:
+            msgs, offset = self.fetch(ns, topic, partition, offset)
+            if msgs:
+                idle_since = time.monotonic()
+                yield from msgs
+            else:
+                if (
+                    stop_after_idle is not None
+                    and time.monotonic() - idle_since > stop_after_idle
+                ):
+                    return
+                time.sleep(poll_interval)

@@ -1,0 +1,43 @@
+"""Consistent-hash ring for partition→broker placement (reference
+`messaging/broker/consistent_distribution.go`, which wraps stathat/consistent:
+20 virtual replicas per member, crc-style hashing, lookup by key)."""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class ConsistentRing:
+    def __init__(self, replicas: int = 20):
+        self.replicas = replicas
+        self._ring: list[tuple[int, str]] = []
+        self._members: set[str] = set()
+
+    def add(self, member: str) -> None:
+        if member in self._members:
+            return
+        self._members.add(member)
+        for i in range(self.replicas):
+            self._ring.append((_hash(f"{member}#{i}"), member))
+        self._ring.sort()
+
+    def remove(self, member: str) -> None:
+        if member not in self._members:
+            return
+        self._members.discard(member)
+        self._ring = [(h, m) for h, m in self._ring if m != member]
+
+    def members(self) -> list[str]:
+        return sorted(self._members)
+
+    def get(self, key: str) -> str:
+        if not self._ring:
+            raise LookupError("empty ring")
+        h = _hash(key)
+        idx = bisect.bisect_right(self._ring, (h, "￿")) % len(self._ring)
+        return self._ring[idx][1]

@@ -1,0 +1,155 @@
+"""Message broker: log buffer, consistent ring, pub/sub over a live stack."""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.messaging import Broker, ConsistentRing, MessagingClient
+from seaweedfs_tpu.messaging.log_buffer import (
+    LogBuffer,
+    decode_messages,
+    encode_message,
+)
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------------ units
+def test_frame_codec():
+    blob = encode_message(123, b"k", b"hello") + encode_message(124, b"", b"x")
+    assert decode_messages(blob) == [(123, b"k", b"hello"), (124, b"", b"x")]
+
+
+def test_log_buffer_flush_and_replay():
+    segments = []
+    buf = LogBuffer(
+        flush_fn=lambda s, e, blob: segments.append((s, e, blob)),
+        flush_bytes=200,
+        flush_interval=60,
+    )
+    ts = [buf.append(b"", bytes([i]) * 50) for i in range(6)]
+    time.sleep(0.3)  # async flush threads
+    assert segments, "size-based flush should have sealed at least one segment"
+    # everything is still readable from memory (prev buffers)
+    got = [v for _, _, v in buf.read_since(0, 100)]
+    assert got == [bytes([i]) * 50 for i in range(6)]
+    # replay from the middle
+    assert len(buf.read_since(ts[3], 100)) == 2
+    buf.close()
+
+
+def test_consistent_ring():
+    ring = ConsistentRing()
+    for m in ["b1", "b2", "b3"]:
+        ring.add(m)
+    keys = [f"topic/{i:02d}" for i in range(50)]
+    before = {k: ring.get(k) for k in keys}
+    assert len(set(before.values())) == 3  # all members used
+    ring.remove("b2")
+    moved = sum(
+        1 for k in keys if before[k] != ring.get(k) and before[k] != "b2"
+    )
+    # consistent hashing: keys not on the removed member mostly stay put
+    assert moved == 0
+    ring.add("b2")
+    assert {k: ring.get(k) for k in keys} == before  # deterministic
+
+
+# ------------------------------------------------------------------- e2e
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("msg")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp / "v")],
+        port=free_port(),
+        master_url=master.url,
+        max_volume_count=20,
+        pulse_seconds=0.5,
+    ).start()
+    filer = FilerServer(
+        port=free_port(), master_url=master.url, chunk_size=64 * 1024
+    ).start()
+    brokers = [
+        Broker(port=free_port(), filer_url=filer.url).start() for _ in range(2)
+    ]
+    time.sleep(0.6)
+    yield brokers, filer
+    for b in brokers:
+        b.stop()
+    filer.stop()
+    volume.stop()
+    master.stop()
+
+
+def test_pub_sub_roundtrip(stack):
+    brokers, _ = stack
+    mc = MessagingClient([b.url for b in brokers])
+    mc.create_topic("chat", "room1", partitions=4)
+    assert mc.topic_conf("chat", "room1")["partitions"] == 4
+    for i in range(20):
+        mc.publish("chat", "room1", f"msg-{i}".encode(), key=b"convo", )
+    # keyed messages all land on one partition, in order
+    got = []
+    for p in range(4):
+        msgs, _ = mc.fetch("chat", "room1", p)
+        got.extend(m["value"].decode() for m in msgs)
+    assert got == [f"msg-{i}" for i in range(20)]
+
+
+def test_replay_from_persisted_segments(stack):
+    brokers, filer = stack
+    mc = MessagingClient([b.url for b in brokers])
+    mc.create_topic("logs", "audit", partitions=1)
+    for i in range(10):
+        mc.publish("logs", "audit", f"ev{i}".encode(), partition=0)
+    # force segment flush to the filer
+    import urllib.request
+
+    for b in brokers:
+        urllib.request.urlopen(
+            urllib.request.Request(f"http://{b.url}/_flush", method="POST"),
+            timeout=10,
+        )
+    time.sleep(0.5)
+    # segments visible as filer files under /topics
+    from seaweedfs_tpu.filer.client import FilerClient
+
+    fc = FilerClient(filer.url)
+    segs = fc.list("/topics/logs/audit/00", limit=100)
+    assert any(e["name"].endswith(".seg") for e in segs)
+    # a fresh subscriber (different broker instance state) replays history
+    msgs, _ = mc.fetch("logs", "audit", 0, since_ns=0)
+    assert [m["value"].decode() for m in msgs] == [f"ev{i}" for i in range(10)]
+
+
+def test_subscribe_tail(stack):
+    brokers, _ = stack
+    mc = MessagingClient([b.url for b in brokers])
+    mc.create_topic("t", "tail", partitions=1)
+    mc.publish("t", "tail", b"first", partition=0)
+    import threading
+
+    got = []
+
+    def consume():
+        for m in mc.subscribe("t", "tail", 0, stop_after_idle=1.5):
+            got.append(m["value"])
+
+    th = threading.Thread(target=consume)
+    th.start()
+    time.sleep(0.3)
+    mc.publish("t", "tail", b"second", partition=0)
+    mc.publish("t", "tail", b"third", partition=0)
+    th.join(timeout=10)
+    assert got == [b"first", b"second", b"third"]
