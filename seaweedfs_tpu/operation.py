@@ -22,6 +22,7 @@ class Assignment:
     public_url: str
     count: int
     replicas: list[str] = field(default_factory=list)
+    auth: str = ""  # fid-scoped write JWT from the master (jwt.go GenJwt)
 
 
 def assign(
@@ -42,6 +43,7 @@ def assign(
         public_url=r.get("publicUrl", r["url"]),
         count=r.get("count", count),
         replicas=r.get("replicas", []),
+        auth=r.get("auth", ""),
     )
 
 
@@ -52,6 +54,7 @@ def upload_data(
     name: str = "",
     mime: str = "",
     ttl: str = "",
+    jwt: str = "",
 ) -> dict:
     import urllib.request
 
@@ -63,6 +66,8 @@ def upload_data(
         req.add_header("X-Sweed-Name", name)
     if mime:
         req.add_header("X-Sweed-Mime", mime)
+    if jwt:
+        req.add_header("Authorization", f"Bearer {jwt}")
     with urllib.request.urlopen(req, timeout=60) as resp:
         import json
 
@@ -111,21 +116,27 @@ def download(master: str, fid: str) -> bytes:
     raise RuntimeError(f"download {fid}: {last_err}")
 
 
-def delete_file(master: str, fid: str) -> bool:
+def delete_file(master: str, fid: str, jwt_key: str = "") -> bool:
     file_id = FileId.parse(fid)
     locs = lookup(master, file_id.volume_id)
+    auth = ""
+    if jwt_key:
+        # deleting clients sharing security.toml sign their own fid token
+        from .security import gen_jwt
+
+        auth = "?auth=" + gen_jwt(jwt_key, fid)
     for loc in locs:
-        status, _ = http_bytes("DELETE", f"http://{loc['url']}/{fid}")
+        status, _ = http_bytes("DELETE", f"http://{loc['url']}/{fid}{auth}")
         if status < 300:
             return True
     return False
 
 
-def delete_files(master: str, fids: list[str]) -> int:
+def delete_files(master: str, fids: list[str], jwt_key: str = "") -> int:
     """Grouped deletion (delete_content.go:32); count of deleted files."""
     ok = 0
     for fid in fids:  # volume-grouping optimization comes with gRPC batching
-        if delete_file(master, fid):
+        if delete_file(master, fid, jwt_key=jwt_key):
             ok += 1
     return ok
 
@@ -143,5 +154,5 @@ def submit(
     a = assign(
         master, replication=replication, collection=collection, ttl=ttl
     )
-    upload_data(a.url, a.fid, data, name=name, mime=mime, ttl=ttl)
+    upload_data(a.url, a.fid, data, name=name, mime=mime, ttl=ttl, jwt=a.auth)
     return a.fid

@@ -1,0 +1,11 @@
+"""Security: per-fid JWT write/read auth + IP whitelist guard
+(reference: `weed/security/jwt.go`, `guard.go`).
+
+The master signs a short-lived fid-scoped token into every assign response;
+volume servers verify it on writes (and on reads when a read key is set).
+Keys are shared secrets (HS256), distributed via config — mirroring
+`security.toml` [jwt.signing] / [jwt.signing.read].
+"""
+
+from .jwt import decode_jwt, gen_jwt, verify_fid_jwt  # noqa: F401
+from .guard import Guard  # noqa: F401

@@ -50,7 +50,9 @@ class FilerServer:
         db_path: str = ":memory:",
         collection: str = "",
         replication: str = "",
+        jwt_signing_key: str = "",
     ):
+        self.jwt_signing_key = jwt_signing_key
         self.host, self.port = host, port
         self.master_url = master_url
         self.chunk_size = chunk_size
@@ -66,7 +68,10 @@ class FilerServer:
 
     def _purge_chunks(self, fids: list[str]) -> None:
         t = threading.Thread(
-            target=operation.delete_files, args=(self.master_url, fids), daemon=True
+            target=operation.delete_files,
+            args=(self.master_url, fids),
+            kwargs={"jwt_key": self.jwt_signing_key},
+            daemon=True,
         )
         t.start()
 
@@ -148,7 +153,7 @@ class FilerServer:
                 replication=replication,
                 ttl=ttl,
             )
-            r = operation.upload_data(a.url, a.fid, piece, ttl=ttl)
+            r = operation.upload_data(a.url, a.fid, piece, ttl=ttl, jwt=a.auth)
             chunks.append(
                 FileChunk(
                     file_id=a.fid,

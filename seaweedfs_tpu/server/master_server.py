@@ -32,7 +32,11 @@ class MasterServer:
         default_replication: str = "000",
         garbage_threshold: float = 0.3,
         node_timeout: float = 15.0,
+        jwt_signing_key: str = "",
+        jwt_expires_seconds: int = 10,
     ):
+        self.jwt_signing_key = jwt_signing_key
+        self.jwt_expires_seconds = jwt_expires_seconds
         self.host, self.port = host, port
         self.master = Master(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
@@ -67,13 +71,21 @@ class MasterServer:
             ttl=q.get("ttl", ""),
             data_center=q.get("dataCenter", ""),
         )
-        return 200, {
+        out = {
             "fid": res.fid,
             "url": res.url,
             "publicUrl": res.public_url,
             "count": res.count,
             "replicas": res.replicas,
         }
+        if self.jwt_signing_key:
+            # fid-scoped write token (security/jwt.go GenJwt via dirAssign)
+            from ..security import gen_jwt
+
+            out["auth"] = gen_jwt(
+                self.jwt_signing_key, res.fid, self.jwt_expires_seconds
+            )
+        return 200, out
 
     def _h_lookup(self, h, path, q, body):
         vid_str = q.get("volumeId", "")

@@ -56,7 +56,15 @@ class VolumeServer:
         max_volume_count: int = 7,
         pulse_seconds: float = 5.0,
         ec_backend: Optional[str] = None,
+        jwt_signing_key: str = "",
+        jwt_read_key: str = "",
+        whitelist: Optional[list[str]] = None,
     ):
+        from ..security import Guard
+
+        self.jwt_signing_key = jwt_signing_key
+        self.jwt_read_key = jwt_read_key
+        self.guard = Guard(whitelist)
         self.host, self.port = host, port
         self.master_url = master_url
         self.data_center, self.rack = data_center, rack
@@ -110,7 +118,28 @@ class VolumeServer:
         nid, cookie = parse_needle_id_cookie(fid)
         return int(vid_str), nid, cookie
 
+    def _auth_ok(self, h, path, q, key: str) -> bool:
+        """JWT must be valid and scoped to the fid being touched
+        (volume_server_handlers_write.go maybeCheckJwtAuthorization)."""
+        if not key:
+            return True
+        from ..security import verify_fid_jwt
+
+        token = q.get("auth", "")
+        ah = h.headers.get("Authorization", "")
+        if not token and ah.startswith("Bearer "):
+            token = ah[len("Bearer ") :]
+        p = path.lstrip("/")
+        if "." in p.rsplit("/", 1)[-1]:
+            p = p[: p.rindex(".")]
+        fid = p.replace("/", ",", 1)
+        return verify_fid_jwt(key, token, fid)
+
     def _h_get(self, h, path, q, body):
+        if not self.guard.allowed(h.client_address[0]):
+            return 403, {"error": "ip not allowed"}
+        if not self._auth_ok(h, path, q, self.jwt_read_key):
+            return 401, {"error": "unauthorized read"}
         vid, nid, cookie = self._parse_fid_path(path)
         n = Needle(id=nid)
         try:
@@ -124,6 +153,10 @@ class VolumeServer:
         return 200, bytes(n.data)
 
     def _h_post(self, h, path, q, body):
+        if not self.guard.allowed(h.client_address[0]):
+            return 403, {"error": "ip not allowed"}
+        if not self._auth_ok(h, path, q, self.jwt_signing_key):
+            return 401, {"error": "unauthorized write"}
         vid, nid, cookie = self._parse_fid_path(path)
         n = Needle(cookie=cookie, id=nid, data=bytes(body))
         name = h.headers.get("X-Sweed-Name")
@@ -157,6 +190,10 @@ class VolumeServer:
         return 201, {"size": len(body), "eTag": n.etag(), "unchanged": unchanged}
 
     def _h_delete(self, h, path, q, body):
+        if not self.guard.allowed(h.client_address[0]):
+            return 403, {"error": "ip not allowed"}
+        if not self._auth_ok(h, path, q, self.jwt_signing_key):
+            return 401, {"error": "unauthorized delete"}
         vid, nid, cookie = self._parse_fid_path(path)
         n = Needle(cookie=cookie, id=nid)
         size = self.store.delete_volume_needle(vid, n)
@@ -178,8 +215,18 @@ class VolumeServer:
             if url == me or url == f"{self.host}:{self.port}":
                 continue
             extra = "&".join(
-                f"{k}={v}" for k, v in q.items() if k not in ("type",)
+                f"{k}={v}" for k, v in q.items() if k not in ("type", "auth")
             )
+            if self.jwt_signing_key:
+                from ..security import gen_jwt
+
+                p = path.lstrip("/")
+                if "." in p.rsplit("/", 1)[-1]:
+                    p = p[: p.rindex(".")]
+                fid = p.replace("/", ",", 1)
+                tok = gen_jwt(self.jwt_signing_key, fid)
+                extra = (extra + "&" if extra else "") + f"auth={tok}"
+
             full = f"http://{url}{path}?type=replicate" + (
                 f"&{extra}" if extra else ""
             )

