@@ -190,6 +190,137 @@ def _report(op, args, latencies, wall):
     print(f"max latency: {lat[-1] * 1000:.2f} ms")
 
 
+def cmd_s3(args):
+    import json as _json
+
+    from .s3api import IAM, S3ApiServer
+
+    iam = IAM()
+    if args.config:
+        with open(args.config) as f:
+            iam = IAM.from_config(_json.load(f))
+    api = S3ApiServer(
+        host=args.ip, port=args.port, filer_url=args.filer, iam=iam
+    ).start()
+    print(f"s3 gateway on {api.url} → filer {args.filer}")
+    _wait_forever()
+
+
+def cmd_webdav(args):
+    from .server.webdav_server import WebDavServer
+
+    srv = WebDavServer(
+        host=args.ip, port=args.port, filer_url=args.filer, root=args.root
+    ).start()
+    print(f"webdav on {srv.url} → filer {args.filer}")
+    _wait_forever()
+
+
+def cmd_msg_broker(args):
+    from .messaging import Broker
+
+    b = Broker(host=args.ip, port=args.port, filer_url=args.filer).start()
+    print(f"message broker on {b.url} → filer {args.filer}")
+    _wait_forever()
+
+
+def cmd_filer_sync(args):
+    from .replication import FilerSync
+
+    syncers = [
+        FilerSync(args.a, args.b, source_path=args.a_path,
+                  target_path=args.b_path).start()
+    ]
+    mode = "active-passive"
+    if not args.is_active_passive:
+        syncers.append(
+            FilerSync(args.b, args.a, source_path=args.b_path,
+                      target_path=args.a_path).start()
+        )
+        mode = "active-active"
+    print(f"filer.sync {mode}: {args.a}{args.a_path} ⇄ {args.b}{args.b_path}")
+    _wait_forever()
+
+
+def cmd_filer_replicate(args):
+    from .filer.client import FilerClient
+    from .replication import LocalFsSink, Replicator, S3Sink
+
+    src = FilerClient(args.filer)
+    if args.sink_s3:
+        endpoint, bucket = args.sink_s3.rsplit("/", 1)
+        sink = S3Sink(endpoint, bucket, args.s3_access_key, args.s3_secret_key)
+    else:
+        sink = LocalFsSink(args.sink_dir)
+    repl = Replicator(
+        sink,
+        read_content=lambda p: src.get_object(p)[1],
+        source_path=args.source,
+    )
+    offset = 0
+    print(f"replicating {args.filer}{args.source} → sink; ctrl-c to stop")
+    while True:
+        resp = src.meta_events(since_ns=offset)
+        for ev in resp.get("events", []):
+            repl.replicate(ev)
+            offset = ev["ts_ns"]
+        if not resp.get("events"):
+            time.sleep(1.0)
+
+
+def cmd_watch(args):
+    """Tail a filer's meta event stream (weed watch)."""
+    import json as _json
+
+    from .filer.client import FilerClient
+
+    client = FilerClient(args.filer)
+    offset = 0
+    while True:
+        resp = client.meta_events(since_ns=offset)
+        for ev in resp.get("events", []):
+            offset = ev["ts_ns"]
+            kind = (
+                "create" if not ev["old_entry"]
+                else "delete" if not ev["new_entry"] else "update"
+            )
+            path = (ev["new_entry"] or ev["old_entry"]).get("full_path")
+            print(f"{ev['ts_ns']} {kind:7s} {path}")
+            if args.verbose:
+                print(_json.dumps(ev, indent=2))
+        if not resp.get("events"):
+            time.sleep(0.5)
+
+
+def cmd_scaffold(args):
+    """Print config templates (weed scaffold)."""
+    templates = {
+        "security": (
+            "# security.json — shared JWT signing keys + whitelist\n"
+            '{\n  "jwt_signing_key": "<random-secret>",\n'
+            '  "jwt_read_key": "",\n  "whitelist": []\n}\n'
+        ),
+        "s3": (
+            "# s3.json — identities for the S3 gateway\n"
+            '{\n  "identities": [\n    {\n      "name": "admin",\n'
+            '      "credentials": [{"accessKey": "AKEXAMPLE", '
+            '"secretKey": "SKEXAMPLE"}],\n      "actions": ["Admin"]\n'
+            "    }\n  ]\n}\n"
+        ),
+        "filer": (
+            "# filer.json — filer store selection\n"
+            '{\n  "store": "sqlite",\n  "db_path": "./filer.db"\n}\n'
+        ),
+        "replication": (
+            "# replication.json — sink for filer.replicate\n"
+            '{\n  "sink": "s3",\n  "endpoint": "http://127.0.0.1:8333",\n'
+            '  "bucket": "mirror"\n}\n'
+        ),
+    }
+    print(templates.get(args.config, f"unknown config {args.config!r}; "
+                                     f"choose from {sorted(templates)}"))
+
+
 def cmd_shell(args):
     from .shell.shell import run_shell
 
@@ -282,6 +413,57 @@ def main(argv=None):
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-collection", default="benchmark")
     b.set_defaults(fn=cmd_benchmark)
+
+    s3 = sub.add_parser("s3", help="S3 gateway over a filer")
+    s3.add_argument("-ip", default="127.0.0.1")
+    s3.add_argument("-port", type=int, default=8333)
+    s3.add_argument("-filer", default="127.0.0.1:8888")
+    s3.add_argument("-config", default="", help="identities json (s3.json)")
+    s3.set_defaults(fn=cmd_s3)
+
+    wd = sub.add_parser("webdav", help="WebDAV gateway over a filer")
+    wd.add_argument("-ip", default="127.0.0.1")
+    wd.add_argument("-port", type=int, default=7333)
+    wd.add_argument("-filer", default="127.0.0.1:8888")
+    wd.add_argument("-root", default="/")
+    wd.set_defaults(fn=cmd_webdav)
+
+    mb = sub.add_parser("msgBroker", help="pub/sub message broker")
+    mb.add_argument("-ip", default="127.0.0.1")
+    mb.add_argument("-port", type=int, default=17777)
+    mb.add_argument("-filer", default="127.0.0.1:8888")
+    mb.set_defaults(fn=cmd_msg_broker)
+
+    fsync = sub.add_parser("filer.sync", help="sync two filer clusters")
+    fsync.add_argument("-a", required=True, help="filer A host:port")
+    fsync.add_argument("-b", required=True, help="filer B host:port")
+    fsync.add_argument("-a.path", dest="a_path", default="/")
+    fsync.add_argument("-b.path", dest="b_path", default="/")
+    fsync.add_argument(
+        "-isActivePassive", dest="is_active_passive", action="store_true"
+    )
+    fsync.set_defaults(fn=cmd_filer_sync)
+
+    frep = sub.add_parser("filer.replicate", help="replicate filer → sink")
+    frep.add_argument("-filer", default="127.0.0.1:8888")
+    frep.add_argument("-source", default="/")
+    frep.add_argument("-sink.dir", dest="sink_dir", default="./replica")
+    frep.add_argument(
+        "-sink.s3", dest="sink_s3", default="",
+        help="http://endpoint/bucket",
+    )
+    frep.add_argument("-s3.accessKey", dest="s3_access_key", default="")
+    frep.add_argument("-s3.secretKey", dest="s3_secret_key", default="")
+    frep.set_defaults(fn=cmd_filer_replicate)
+
+    w = sub.add_parser("watch", help="tail filer meta events")
+    w.add_argument("-filer", default="127.0.0.1:8888")
+    w.add_argument("-v", dest="verbose", action="store_true")
+    w.set_defaults(fn=cmd_watch)
+
+    sc = sub.add_parser("scaffold", help="print config templates")
+    sc.add_argument("-config", default="security")
+    sc.set_defaults(fn=cmd_scaffold)
 
     sh = sub.add_parser("shell", help="admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
