@@ -1,0 +1,5 @@
+"""S3-Select-ish content query engine (reference `weed/server/
+volume_grpc_query.go:12` + `weed/query/json`): server-side filtering and
+projection of CSV / JSON-lines object content."""
+
+from .engine import run_query  # noqa: F401

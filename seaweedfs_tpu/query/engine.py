@@ -1,0 +1,101 @@
+"""Query evaluation over CSV / JSON-lines blobs.
+
+Filter spec: {"field": "a.b", "op": "=", "value": x} — ops =, !=, <, <=, >,
+>=, contains, starts_with. Projection: list of (dotted) field names or ["*"].
+Mirrors the semantics of `volume_grpc_query.go` (gjson path lookup + the
+same operator set) without the SQL front-end.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+from typing import Any, Optional
+
+
+def _get_path(doc: dict, path: str) -> Any:
+    cur: Any = doc
+    for part in path.split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        elif isinstance(cur, list) and part.isdigit():
+            idx = int(part)
+            cur = cur[idx] if idx < len(cur) else None
+        else:
+            return None
+    return cur
+
+
+def _coerce_pair(a: Any, b: Any) -> tuple[Any, Any]:
+    """Compare numerically when both sides look numeric."""
+    try:
+        return float(a), float(b)
+    except (TypeError, ValueError):
+        return str(a), str(b)
+
+
+def _matches(doc: dict, flt: Optional[dict]) -> bool:
+    if not flt:
+        return True
+    got = _get_path(doc, flt.get("field", ""))
+    op = flt.get("op", "=")
+    want = flt.get("value")
+    if op in ("contains", "starts_with"):
+        s, w = str(got or ""), str(want or "")
+        return s.find(w) >= 0 if op == "contains" else s.startswith(w)
+    if got is None:
+        return False
+    a, b = _coerce_pair(got, want)
+    if op == "=":
+        return a == b
+    if op == "!=":
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    raise ValueError(f"unknown op {op!r}")
+
+
+def _project(doc: dict, select: Optional[list[str]]) -> dict:
+    if not select or select == ["*"]:
+        return doc
+    return {f: _get_path(doc, f) for f in select}
+
+
+def _iter_docs(data: bytes, input_format: str):
+    if input_format == "csv":
+        text = data.decode("utf-8", errors="replace")
+        yield from csv.DictReader(io.StringIO(text))
+        return
+    # json: one object per line, or a single array/object
+    text = data.decode("utf-8", errors="replace").strip()
+    if text.startswith("["):
+        for doc in json.loads(text):
+            yield doc
+        return
+    for line in text.splitlines():
+        line = line.strip()
+        if line:
+            yield json.loads(line)
+
+
+def run_query(
+    data: bytes,
+    input_format: str = "json",
+    select: Optional[list[str]] = None,
+    where: Optional[dict] = None,
+    limit: int = 0,
+) -> list[dict]:
+    out = []
+    for doc in _iter_docs(data, input_format):
+        if _matches(doc, where):
+            out.append(_project(doc, select))
+            if limit and len(out) >= limit:
+                break
+    return out

@@ -51,8 +51,21 @@ class FilerServer:
         collection: str = "",
         replication: str = "",
         jwt_signing_key: str = "",
+        chunk_cache_dir: str = "",
+        chunk_cache_mem_mb: int = 64,
     ):
+        from ..stats import default_registry
+        from ..util.chunk_cache import TieredChunkCache
+
         self.jwt_signing_key = jwt_signing_key
+        self.chunk_cache = TieredChunkCache(
+            directory=chunk_cache_dir or None,
+            mem_budget=chunk_cache_mem_mb * 1024 * 1024,
+        )
+        self.metrics = default_registry
+        self._req_hist = self.metrics.histogram(
+            "filer_request_seconds", "filer request latency"
+        )
         self.host, self.port = host, port
         self.master_url = master_url
         self.chunk_size = chunk_size
@@ -111,7 +124,35 @@ class FilerServer:
             "signature": self.signature,
             "url": self.url,
             "master": self.master_url,
+            "chunk_cache": {
+                "hits": self.chunk_cache.mem.hits,
+                "misses": self.chunk_cache.mem.misses,
+            },
         }
+
+    def _h_metrics(self, h, path, q, body):
+        return 200, self.metrics.expose().encode()
+
+    def _h_query(self, h, path, q, body):
+        """S3-Select-ish scan of a stored CSV/JSON file
+        (volume_grpc_query.go analog at the filer level)."""
+        from ..query import run_query
+
+        req = json.loads(body)
+        target = req.get("path", "")
+        try:
+            entry = self.filer.find_entry(target)
+        except NotFoundError:
+            return 404, {"error": f"{target} not found"}
+        data = self._read_range(entry, 0, entry.file_size())
+        rows = run_query(
+            data,
+            input_format=req.get("input", "json"),
+            select=req.get("select"),
+            where=req.get("where"),
+            limit=int(req.get("limit", 0)),
+        )
+        return 200, {"rows": rows, "count": len(rows)}
 
     @staticmethod
     def _sigs(q) -> Optional[list[int]]:
@@ -120,6 +161,10 @@ class FilerServer:
 
     # -- write path (auto-chunking) ------------------------------------------
     def _h_write(self, h, path, q, body):
+        with self._req_hist.time(op="write"):
+            return self._h_write_inner(h, path, q, body)
+
+    def _h_write_inner(self, h, path, q, body):
         path = urllib.parse.unquote(path)
         if q.get("mv.to"):
             entry = self.filer.rename(path.rstrip("/") or "/", q["mv.to"])
@@ -190,6 +235,10 @@ class FilerServer:
 
     # -- read path ------------------------------------------------------------
     def _h_read(self, h, path, q, body):
+        with self._req_hist.time(op="read"):
+            return self._h_read_inner(h, path, q, body)
+
+    def _h_read_inner(self, h, path, q, body):
         path = urllib.parse.unquote(path)
         lookup = path.rstrip("/") or "/"
         try:
@@ -270,19 +319,21 @@ class FilerServer:
         views = view_from_chunks(entry.chunks, offset, size)
         out = bytearray(size)
         for view in views:
-            fid = FileId.parse(view.file_id)
-            locs = self._lookup.lookup(fid.volume_id)
-            data = None
-            for loc in locs:
-                status, body = http_bytes(
-                    "GET", f"http://{loc['url']}/{view.file_id}"
-                )
-                if status == 200:
-                    data = body
-                    break
+            data = self.chunk_cache.get(view.file_id)
             if data is None:
-                self._lookup.invalidate(fid.volume_id)
-                data = operation.download(self.master_url, view.file_id)
+                fid = FileId.parse(view.file_id)
+                locs = self._lookup.lookup(fid.volume_id)
+                for loc in locs:
+                    status, body = http_bytes(
+                        "GET", f"http://{loc['url']}/{view.file_id}"
+                    )
+                    if status == 200:
+                        data = body
+                        break
+                if data is None:
+                    self._lookup.invalidate(fid.volume_id)
+                    data = operation.download(self.master_url, view.file_id)
+                self.chunk_cache.put(view.file_id, data)
             piece = data[view.offset : view.offset + view.size]
             pos = view.logic_offset - offset
             out[pos : pos + len(piece)] = piece
@@ -322,6 +373,8 @@ class FilerServer:
             routes = [
                 ("GET", "/_meta/events", fs._h_meta_events),
                 ("GET", "/_status", fs._h_status),
+                ("GET", "/metrics", fs._h_metrics),
+                ("POST", "/_query", fs._h_query),
                 ("GET", "/_kv/", fs._h_kv),
                 ("PUT", "/_kv/", fs._h_kv),
                 ("POST", "/_kv/", fs._h_kv),

@@ -61,7 +61,15 @@ class VolumeServer:
         whitelist: Optional[list[str]] = None,
     ):
         from ..security import Guard
+        from ..stats import default_registry
 
+        self.metrics = default_registry
+        self._req_hist = self.metrics.histogram(
+            "volume_server_request_seconds", "volume server request latency"
+        )
+        self._req_count = self.metrics.counter(
+            "volume_server_request_total", "volume server requests"
+        )
         self.jwt_signing_key = jwt_signing_key
         self.jwt_read_key = jwt_read_key
         self.guard = Guard(whitelist)
@@ -140,17 +148,32 @@ class VolumeServer:
             return 403, {"error": "ip not allowed"}
         if not self._auth_ok(h, path, q, self.jwt_read_key):
             return 401, {"error": "unauthorized read"}
-        vid, nid, cookie = self._parse_fid_path(path)
-        n = Needle(id=nid)
-        try:
-            self.store.read_volume_needle(vid, n)
-        except (NotFoundError, Exception) as e:
-            if isinstance(e, (NotFoundError, DeletedError)) or "not in ecx" in str(e):
-                return 404, {"error": str(e)}
-            raise
-        if n.cookie != cookie:
-            return 404, {"error": "cookie mismatch"}
-        return 200, bytes(n.data)
+        self._req_count.inc(op="get")
+        with self._req_hist.time(op="get"):
+            vid, nid, cookie = self._parse_fid_path(path)
+            n = Needle(id=nid)
+            try:
+                self.store.read_volume_needle(vid, n)
+            except (NotFoundError, Exception) as e:
+                if isinstance(e, (NotFoundError, DeletedError)) or "not in ecx" in str(e):
+                    return 404, {"error": str(e)}
+                raise
+            if n.cookie != cookie:
+                return 404, {"error": "cookie mismatch"}
+            data = bytes(n.data)
+            if q.get("width") or q.get("height"):
+                # on-read auto-resize for image needles (images/resizing.go)
+                from ..util import images
+
+                mime = n.mime.decode() if n.mime else "image/jpeg"
+                data = images.resized(
+                    data,
+                    mime,
+                    int(q["width"]) if q.get("width") else None,
+                    int(q["height"]) if q.get("height") else None,
+                    q.get("mode", ""),
+                )
+            return 200, data
 
     def _h_post(self, h, path, q, body):
         if not self.guard.allowed(h.client_address[0]):
@@ -431,6 +454,9 @@ class VolumeServer:
             return 404, {"error": f"shard {vid}.{sid} not here"}
         return 200, ev.shards[sid].read_at(offset, size)
 
+    def _h_metrics(self, h, path, q, body):
+        return 200, self.metrics.expose().encode()
+
     def _h_status(self, h, path, q, body):
         hb = self.store.collect_heartbeat()
         hb["ec"] = self.store.collect_ec_heartbeat()["ec_shards"]
@@ -480,6 +506,7 @@ class VolumeServer:
                 ("POST", "/admin/ec/delete_shards", vs._h_ec_delete_shards),
                 ("GET", "/admin/file", vs._h_file),
                 ("GET", "/status", vs._h_status),
+                ("GET", "/metrics", vs._h_metrics),
                 ("GET", "/", vs._h_get),
                 ("HEAD", "/", vs._h_get),
                 ("POST", "/", vs._h_post),

@@ -1,0 +1,12 @@
+"""Metrics (reference `weed/stats/metrics.go:19-100`): Prometheus-style
+counters/gauges/histograms with a text exposition endpoint."""
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+    disk_status,
+    memory_status,
+)

@@ -1,0 +1,185 @@
+"""Stdlib Prometheus-style metrics registry + host probes.
+
+Reference `weed/stats/metrics.go` registers counters/gauges/histograms for
+filer/volume/store requests and pushes or exposes them; `disk.go`/`memory.go`
+probe the host. Exposition follows the Prometheus text format so existing
+scrapers/dashboards (other/metrics/grafana_seaweedfs.json) can consume it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = {}
+        self._fns: dict[tuple, callable] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = float(value)
+
+    def set_function(self, fn, **labels) -> None:
+        """Lazily-evaluated gauge (e.g. live disk probe)."""
+        with self._lock:
+            self._fns[tuple(sorted(labels.items()))] = fn
+
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        if key in self._fns:
+            return float(self._fns[key]())
+        return self._values.get(key, 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = {**self._values}
+            for key, fn in self._fns.items():
+                try:
+                    items[key] = float(fn())
+                except Exception:
+                    pass
+        for key, v in sorted(items.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+_DEFAULT_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", buckets=None):
+        self.name, self.help = name, help_
+        self.buckets = tuple(buckets or _DEFAULT_BUCKETS)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sum: dict[tuple, float] = {}
+        self._total: dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sum[key] = self._sum.get(key, 0.0) + value
+            self._total[key] = self._total.get(key, 0) + 1
+
+    def time(self, **labels):
+        """with hist.time(op="read"): ..."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0, **labels)
+
+        return _Timer()
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key in sorted(self._counts):
+            labels = dict(key)
+            for i, b in enumerate(self.buckets):
+                lb = {**labels, "le": repr(b)}
+                out.append(f"{self.name}_bucket{_fmt_labels(lb)} {self._counts[key][i]}")
+            lb = {**labels, "le": "+Inf"}
+            out.append(f"{self.name}_bucket{_fmt_labels(lb)} {self._total[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sum[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} {self._total[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "", buckets=None) -> Histogram:
+        return self._get_or_make(name, lambda: Histogram(name, help_, buckets))
+
+    def _get_or_make(self, name, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+default_registry = Registry()
+
+
+# -- host probes (stats/disk.go, memory.go) ----------------------------------
+def disk_status(path: str) -> dict:
+    st = os.statvfs(path)
+    total = st.f_blocks * st.f_frsize
+    free = st.f_bavail * st.f_frsize
+    return {"dir": path, "all": total, "free": free, "used": total - free}
+
+
+def memory_status() -> dict:
+    out = {}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith(("VmRSS:", "VmSize:")):
+                    k, v = line.split(":", 1)
+                    out[k.lower()] = int(v.strip().split()[0]) * 1024
+    except OSError:
+        pass
+    return out

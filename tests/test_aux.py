@@ -1,0 +1,210 @@
+"""Auxiliary subsystems: chunk cache, images, query engine, metrics."""
+
+import io
+import json
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.query import run_query
+from seaweedfs_tpu.stats import Registry, disk_status, memory_status
+from seaweedfs_tpu.util.chunk_cache import TieredChunkCache
+from seaweedfs_tpu.util.images import HAVE_PIL, fix_orientation, resized
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -------------------------------------------------------------- chunk cache
+def test_chunk_cache_tiers(tmp_path):
+    cache = TieredChunkCache(
+        directory=str(tmp_path / "cc"),
+        mem_budget=1000,
+        mem_limit=100,
+        disk_budget=10_000,
+        disk_limit=5_000,
+    )
+    cache.put("1,aa", b"x" * 50)  # memory tier
+    cache.put("1,bb", b"y" * 500)  # disk tier (over mem_limit)
+    cache.put("1,cc", b"z" * 9_000)  # over disk_limit: dropped
+    assert cache.get("1,aa") == b"x" * 50
+    assert cache.get("1,bb") == b"y" * 500
+    assert cache.get("1,cc") is None
+    assert cache.mem.hits == 1 and cache.mem.misses >= 2
+
+
+def test_chunk_cache_lru_eviction():
+    cache = TieredChunkCache(mem_budget=250, mem_limit=100)
+    for i in range(5):
+        cache.put(f"f{i}", bytes([i]) * 100)  # budget holds only 2
+    assert cache.get("f0") is None
+    assert cache.get("f4") == bytes([4]) * 100
+
+
+# ------------------------------------------------------------------- images
+@pytest.mark.skipif(not HAVE_PIL, reason="PIL not available")
+def test_image_resize_fit_and_fill():
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (100, 60), "red").save(buf, format="PNG")
+    png = buf.getvalue()
+    out = resized(png, "image/png", width=50, height=50, mode="")
+    w, h = Image.open(io.BytesIO(out)).size
+    assert (w, h) == (50, 30)  # fit keeps ratio
+    out = resized(png, "image/png", width=40, height=40, mode="fill")
+    assert Image.open(io.BytesIO(out)).size == (40, 40)  # fill crops
+    # non-image and missing dims pass through untouched
+    assert resized(b"not an image", "text/plain", 10, 10) == b"not an image"
+    assert resized(png, "image/png") == png
+
+
+@pytest.mark.skipif(not HAVE_PIL, reason="PIL not available")
+def test_exif_orientation():
+    from PIL import Image
+
+    buf = io.BytesIO()
+    img = Image.new("RGB", (60, 30), "blue")
+    exif = img.getexif()
+    exif[274] = 6  # rotate 270 → portrait
+    img.save(buf, format="JPEG", exif=exif.tobytes())
+    fixed = fix_orientation(buf.getvalue())
+    out = Image.open(io.BytesIO(fixed))
+    assert out.size == (30, 60)
+    assert out.getexif().get(274, 1) == 1
+
+
+# -------------------------------------------------------------------- query
+DOCS = b"""\
+{"name": "alice", "age": 31, "addr": {"city": "ams"}}
+{"name": "bob", "age": 25, "addr": {"city": "nyc"}}
+{"name": "carol", "age": 40, "addr": {"city": "ams"}}
+"""
+
+
+def test_query_json_filter_project():
+    rows = run_query(DOCS, where={"field": "addr.city", "op": "=", "value": "ams"})
+    assert [r["name"] for r in rows] == ["alice", "carol"]
+    rows = run_query(
+        DOCS,
+        select=["name"],
+        where={"field": "age", "op": ">", "value": 30},
+    )
+    assert rows == [{"name": "alice"}, {"name": "carol"}]
+    rows = run_query(DOCS, where={"field": "name", "op": "contains", "value": "aro"})
+    assert len(rows) == 1 and rows[0]["name"] == "carol"
+    assert len(run_query(DOCS, limit=2)) == 2
+
+
+def test_query_csv():
+    data = b"name,qty\nwidget,5\ngadget,12\n"
+    rows = run_query(
+        data, input_format="csv", where={"field": "qty", "op": ">=", "value": 10}
+    )
+    assert rows == [{"name": "gadget", "qty": "12"}]
+
+
+# ------------------------------------------------------------------ metrics
+def test_metrics_registry_exposition():
+    reg = Registry()
+    c = reg.counter("requests_total", "total requests")
+    c.inc(op="get")
+    c.inc(2, op="get")
+    g = reg.gauge("volumes", "volume count")
+    g.set(7, disk="hdd")
+    hist = reg.histogram("latency_seconds", "latency")
+    hist.observe(0.003, op="read")
+    with hist.time(op="read"):
+        pass
+    text = reg.expose()
+    assert 'requests_total{op="get"} 3.0' in text
+    assert 'volumes{disk="hdd"} 7.0' in text
+    assert 'latency_seconds_count{op="read"} 2' in text
+    assert "# TYPE latency_seconds histogram" in text
+    # same name returns same metric
+    assert reg.counter("requests_total") is c
+
+
+def test_host_probes(tmp_path):
+    d = disk_status(str(tmp_path))
+    assert d["all"] > 0 and 0 < d["free"] <= d["all"]
+    m = memory_status()
+    assert m.get("vmrss", 0) > 0
+
+
+# ------------------------------------------------- server integration (e2e)
+@pytest.fixture(scope="module")
+def mini(tmp_path_factory):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    tmp = tmp_path_factory.mktemp("aux")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp / "v")],
+        port=free_port(),
+        master_url=master.url,
+        max_volume_count=10,
+        pulse_seconds=0.5,
+    ).start()
+    filer = FilerServer(
+        port=free_port(), master_url=master.url, chunk_size=64 * 1024
+    ).start()
+    time.sleep(0.5)
+    yield master, volume, filer
+    filer.stop()
+    volume.stop()
+    master.stop()
+
+
+def test_metrics_endpoints(mini):
+    from seaweedfs_tpu.server.http_util import http_bytes
+
+    _, volume, filer = mini
+    http_bytes("POST", f"http://{filer.url}/m/f.txt", b"data")
+    http_bytes("GET", f"http://{filer.url}/m/f.txt")
+    status, text = http_bytes("GET", f"http://{filer.url}/metrics")
+    assert status == 200 and b"filer_request_seconds" in text
+    status, text = http_bytes("GET", f"http://{volume.url if hasattr(volume,'url') else f'{volume.host}:{volume.port}'}/metrics")
+    assert status == 200 and b"volume_server_request_total" in text
+
+
+def test_filer_query_endpoint(mini):
+    from seaweedfs_tpu.server.http_util import http_bytes, http_json
+
+    _, _, filer = mini
+    http_bytes("POST", f"http://{filer.url}/q/data.jsonl", DOCS)
+    r = http_json(
+        "POST",
+        f"http://{filer.url}/_query",
+        body={
+            "path": "/q/data.jsonl",
+            "where": {"field": "addr.city", "op": "=", "value": "nyc"},
+            "select": ["name", "age"],
+        },
+    )
+    assert r["count"] == 1 and r["rows"] == [{"name": "bob", "age": 25}]
+
+
+@pytest.mark.skipif(not HAVE_PIL, reason="PIL not available")
+def test_volume_image_resize(mini):
+    from PIL import Image
+
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.server.http_util import http_bytes
+
+    master, _, _ = mini
+    buf = io.BytesIO()
+    Image.new("RGB", (80, 40), "green").save(buf, format="PNG")
+    a = operation.assign(master.url)
+    operation.upload_data(a.url, a.fid, buf.getvalue(), mime="image/png")
+    status, data = http_bytes("GET", f"http://{a.url}/{a.fid}?width=40")
+    assert status == 200
+    assert Image.open(io.BytesIO(data)).size == (40, 20)
