@@ -190,6 +190,16 @@ def _report(op, args, latencies, wall):
     print(f"max latency: {lat[-1] * 1000:.2f} ms")
 
 
+def cmd_backup(args):
+    from .storage.volume_backup import backup_volume
+
+    r = backup_volume(args.master, args.volume, args.dir, args.collection)
+    print(
+        f"volume {r['volume']} ← {r['from']}: +{r['writes']} writes, "
+        f"+{r['deletes']} deletes (now {r['file_count']} files)"
+    )
+
+
 def cmd_s3(args):
     import json as _json
 
@@ -413,6 +423,13 @@ def main(argv=None):
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-collection", default="benchmark")
     b.set_defaults(fn=cmd_benchmark)
+
+    bk = sub.add_parser("backup", help="incremental local volume backup")
+    bk.add_argument("-master", default="127.0.0.1:9333")
+    bk.add_argument("-volume", type=int, required=True)
+    bk.add_argument("-dir", default=".")
+    bk.add_argument("-collection", default="")
+    bk.set_defaults(fn=cmd_backup)
 
     s3 = sub.add_parser("s3", help="S3 gateway over a filer")
     s3.add_argument("-ip", default="127.0.0.1")

@@ -258,6 +258,43 @@ class VolumeServer:
                 errors.append(f"{url}: {status} {resp[:100]!r}")
         return "; ".join(errors) if errors else None
 
+    # -- tail / tier (volume_grpc_tail.go, volume_grpc_tier_*.go) ------------
+    def _h_tail(self, h, path, q, body):
+        """Binary needle stream: frames of [4B len][record bytes] for records
+        appended after since_ns (VolumeTailSender)."""
+        v = self.store.find_volume(int(q["volume"]))
+        if v is None:
+            return 404, {"error": "volume not found"}
+        since = int(q.get("since_ns", 0))
+        out = bytearray()
+        for n in v.tail_needles(since):
+            blob = n.to_bytes(v.version)
+            out += len(blob).to_bytes(4, "big") + blob
+        h.extra_headers = {"X-Volume-Version": str(v.version)}
+        return 200, bytes(out)
+
+    def _h_tier_upload(self, h, path, q, body):
+        v = self.store.find_volume(int(q["volume"]))
+        if v is None:
+            return 404, {"error": "volume not found"}
+        info = v.tier_upload(
+            q["endpoint"],
+            q["bucket"],
+            access_key=q.get("accessKey", ""),
+            secret_key=q.get("secretKey", ""),
+            keep_local=q.get("keepLocal") == "true",
+        )
+        return 200, info
+
+    def _h_tier_download(self, h, path, q, body):
+        v = self.store.find_volume(int(q["volume"]))
+        if v is None:
+            return 404, {"error": "volume not found"}
+        v.tier_download(
+            access_key=q.get("accessKey", ""), secret_key=q.get("secretKey", "")
+        )
+        return 200, {"ok": True}
+
     # -- admin: volumes ------------------------------------------------------
     def _h_assign_volume(self, h, path, q, body):
         vid = int(q["volume"])
@@ -497,6 +534,9 @@ class VolumeServer:
                 ("GET", "/admin/vacuum_check", vs._h_vacuum_check),
                 ("POST", "/admin/vacuum", vs._h_vacuum),
                 ("POST", "/admin/volume_copy", vs._h_volume_copy),
+                ("GET", "/admin/tail", vs._h_tail),
+                ("POST", "/admin/tier_upload", vs._h_tier_upload),
+                ("POST", "/admin/tier_download", vs._h_tier_download),
                 ("POST", "/admin/ec/generate", vs._h_ec_generate),
                 ("POST", "/admin/ec/rebuild", vs._h_ec_rebuild),
                 ("POST", "/admin/ec/copy", vs._h_ec_copy),

@@ -321,3 +321,37 @@ def _copy_volume(
         f"&collection={collection}",
     )
     return not r.get("error")
+
+
+def volume_tier_upload(
+    env: CommandEnv,
+    vid: int,
+    endpoint: str,
+    bucket: str,
+    keep_local: bool = False,
+) -> dict:
+    """Move a sealed volume's .dat to an S3-compatible tier
+    (shell/command_volume_tier_upload.go)."""
+    locs = env.volume_locations(vid)
+    if not locs:
+        raise RuntimeError(f"volume {vid} not found")
+    results = []
+    for loc in locs:
+        r = http_json(
+            "POST",
+            f"http://{loc}/admin/tier_upload?volume={vid}&endpoint={endpoint}"
+            f"&bucket={bucket}&keepLocal={'true' if keep_local else 'false'}",
+        )
+        results.append({"server": loc} | r)
+    return {"tiered": results}
+
+
+def volume_tier_download(env: CommandEnv, vid: int) -> dict:
+    """Fetch a tiered volume's .dat back to local disk
+    (shell/command_volume_tier_download.go)."""
+    locs = env.volume_locations(vid)
+    results = []
+    for loc in locs:
+        r = http_json("POST", f"http://{loc}/admin/tier_download?volume={vid}")
+        results.append({"server": loc} | r)
+    return {"downloaded": results}

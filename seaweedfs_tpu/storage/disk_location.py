@@ -50,7 +50,9 @@ class DiskLocation:
                     continue
                 base, ext = os.path.splitext(entry)
                 try:
-                    if ext == ".dat":
+                    # .tier marks a sealed volume whose .dat moved to a
+                    # remote backend — discover it like a local one
+                    if ext in (".dat", ".tier"):
                         collection, vid = parse_volume_base_name(base)
                         if vid not in self.volumes:
                             self.volumes[vid] = Volume(

@@ -86,11 +86,25 @@ class Volume:
         self._is_compacting = False
 
         base = self.file_name()
-        dat_exists = os.path.exists(base + ".dat")
+        tier_exists = os.path.exists(base + ".tier")
+        dat_exists = os.path.exists(base + ".dat") or tier_exists
         if not dat_exists and not create_if_missing:
             raise FileNotFoundError(base + ".dat")
 
-        self.data_backend: BackendStorageFile = DiskFile(base + ".dat", create=True)
+        if tier_exists:
+            # sealed volume whose .dat lives on a remote tier
+            import json as _json
+
+            from .backend import RemoteS3File
+
+            with open(base + ".tier") as f:
+                info = _json.load(f)
+            self.data_backend: BackendStorageFile = RemoteS3File(
+                info["endpoint"], info["bucket"], info["key"], size=info["size"]
+            )
+            self.read_only = True
+        else:
+            self.data_backend = DiskFile(base + ".dat", create=True)
         if dat_exists and self.data_backend.size() >= SUPER_BLOCK_SIZE:
             import struct as _struct
 
@@ -251,7 +265,12 @@ class Volume:
                     out.write(idx_mod.pack_entry(n.id, offset, -1, self.offset_size))
 
     # -- write path (volume_read_write.go:78-128) ----------------------------
-    def write_needle(self, n: Needle, fsync: bool = False) -> tuple[int, int, bool]:
+    def write_needle(
+        self,
+        n: Needle,
+        fsync: bool = False,
+        append_at_ns: Optional[int] = None,
+    ) -> tuple[int, int, bool]:
         """Returns (offset, size, is_unchanged)."""
         if self.read_only:
             raise VolumeError(f"volume {self.id} is read only")
@@ -282,7 +301,7 @@ class Volume:
                     raise
                 except Exception as e:
                     raise VolumeError(f"reading existing needle: {e}")
-            n.append_at_ns = time.time_ns()
+            n.append_at_ns = append_at_ns or time.time_ns()
             blob = n.to_bytes(self.version)
             offset = self.data_backend.append(blob)
             self.last_append_at_ns = n.append_at_ns
@@ -312,7 +331,9 @@ class Volume:
         return old.cookie == n.cookie and old.data == n.data
 
     # -- delete path (volume_read_write.go:194-220) --------------------------
-    def delete_needle(self, n: Needle) -> int:
+    def delete_needle(
+        self, n: Needle, append_at_ns: Optional[int] = None
+    ) -> int:
         """Returns the size of the deleted needle (0 if absent)."""
         if self.read_only:
             raise VolumeError(f"volume {self.id} is read only")
@@ -322,7 +343,7 @@ class Volume:
                 return 0
             size = nv.size
             n.data = b""
-            n.append_at_ns = time.time_ns()
+            n.append_at_ns = append_at_ns or time.time_ns()
             blob = n.to_bytes(self.version)
             offset = self.data_backend.append(blob)
             self.last_append_at_ns = n.append_at_ns
@@ -386,6 +407,87 @@ class Volume:
                     raise
             yield n, offset, total
             offset += total
+
+    # -- tail / backup (storage/volume_backup.go) ----------------------------
+    def tail_needles(self, since_ns: int) -> Iterator[Needle]:
+        """Records appended after since_ns, in append order — the incremental
+        backup/follow stream (BackupVolume / VolumeTailSender). Tombstones
+        appear as size-0 records; replay maps them to deletes."""
+        for n, _, _ in self.scan_needles():
+            if n.append_at_ns > since_ns:
+                yield n
+
+    # -- cloud tier (storage/volume_tier.go) ---------------------------------
+    def tier_file(self) -> str:
+        return self.file_name() + ".tier"
+
+    def tier_upload(
+        self,
+        endpoint: str,
+        bucket: str,
+        access_key: str = "",
+        secret_key: str = "",
+        keep_local: bool = False,
+    ) -> dict:
+        """Seal the volume and move its .dat to an S3-compatible backend,
+        keeping .idx local; reads continue through ranged GETs
+        (volume_tier.go + volume_grpc_tier_upload.go)."""
+        import json as _json
+
+        from .backend import DiskFile, RemoteS3File
+        from ..s3api.s3_client import S3Client
+
+        with self._lock:
+            self.read_only = True
+            self.data_backend.sync()
+            key = f"{self.collection or 'default'}_{self.id}.dat"
+            size = self.data_backend.size()
+            client = S3Client(endpoint, access_key, secret_key)
+            client.create_bucket(bucket)  # idempotent-ish; 409 is fine
+            data = self.data_backend.read_at(0, size)
+            status, _, _ = client.put_object(bucket, key, data)
+            if status != 200:
+                raise VolumeError(f"tier upload failed: HTTP {status}")
+            info = {
+                "endpoint": endpoint,
+                "bucket": bucket,
+                "key": key,
+                "size": size,
+            }
+            with open(self.tier_file(), "w") as f:
+                _json.dump(info, f)
+            local = self.file_name() + ".dat"
+            self.data_backend.close()
+            self.data_backend = RemoteS3File(
+                endpoint, bucket, key, access_key, secret_key, size=size
+            )
+            if not keep_local:
+                os.unlink(local)
+            return info
+
+    def tier_download(
+        self, access_key: str = "", secret_key: str = ""
+    ) -> None:
+        """Fetch the .dat back from the remote tier (volume_grpc_tier_download.go)."""
+        import json as _json
+
+        from .backend import DiskFile
+        from ..s3api.s3_client import S3Client
+
+        with self._lock:
+            with open(self.tier_file()) as f:
+                info = _json.load(f)
+            client = S3Client(info["endpoint"], access_key, secret_key)
+            status, data, _ = client.get_object(info["bucket"], info["key"])
+            if status != 200:
+                raise VolumeError(f"tier download failed: HTTP {status}")
+            local = self.file_name() + ".dat"
+            with open(local + ".tmp", "wb") as f:
+                f.write(data)
+            os.replace(local + ".tmp", local)
+            self.data_backend.close()
+            self.data_backend = DiskFile(local)
+            os.unlink(self.tier_file())
 
     # -- vacuum / compaction (volume_vacuum.go) ------------------------------
     def compact(self) -> None:

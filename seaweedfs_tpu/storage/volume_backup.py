@@ -1,0 +1,64 @@
+"""Incremental volume backup by append-timestamp tail (reference
+`weed/storage/volume_backup.go` + `weed/command/backup.go`): a local copy
+volume tracks its own last_append_at_ns; each run fetches only records
+appended since then and replays them — size-0 tombstones as deletes,
+everything else as timestamp-preserving writes — so repeated runs converge
+and resume."""
+
+from __future__ import annotations
+
+from ..server.http_util import http_bytes, http_json
+from .needle import Needle, parse_needle_header
+from .needle import NEEDLE_HEADER_SIZE  # re-exported there
+from .volume import Volume
+
+
+def parse_tail_frames(blob: bytes, version: int) -> list[Needle]:
+    out = []
+    pos = 0
+    while pos + 4 <= len(blob):
+        ln = int.from_bytes(blob[pos : pos + 4], "big")
+        pos += 4
+        rec = blob[pos : pos + ln]
+        pos += ln
+        _, _, size = parse_needle_header(rec[:NEEDLE_HEADER_SIZE])
+        out.append(Needle.from_bytes(rec, size, version))
+    return out
+
+
+def backup_volume(
+    master_url: str, vid: int, directory: str, collection: str = ""
+) -> dict:
+    """One incremental backup pass. Returns counters."""
+    r = http_json("GET", f"http://{master_url}/dir/lookup?volumeId={vid}")
+    locs = r.get("locations", [])
+    if not locs:
+        raise RuntimeError(f"volume {vid} not found on any server")
+    src = locs[0]["url"]
+    local = Volume(directory, collection, vid)
+    try:
+        since = local.last_append_at_ns
+        status, blob = http_bytes(
+            "GET", f"http://{src}/admin/tail?volume={vid}&since_ns={since}"
+        )
+        if status != 200:
+            raise RuntimeError(f"tail from {src}: HTTP {status}")
+        writes = deletes = 0
+        for n in parse_tail_frames(blob, local.version):
+            if n.size == 0 and not n.data:
+                local.delete_needle(n, append_at_ns=n.append_at_ns)
+                deletes += 1
+            else:
+                local.write_needle(n, append_at_ns=n.append_at_ns)
+                writes += 1
+        local.sync()
+        return {
+            "volume": vid,
+            "from": src,
+            "since_ns": since,
+            "writes": writes,
+            "deletes": deletes,
+            "file_count": local.file_count(),
+        }
+    finally:
+        local.close()
