@@ -13,6 +13,7 @@ from __future__ import annotations
 import secrets
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -52,12 +53,20 @@ class Master:
         self.default_replication = ReplicaPlacement.from_string(default_replication)
         self.garbage_threshold = garbage_threshold
         self.pulse_seconds = pulse_seconds
-        self.vg = VolumeGrowth(allocate_volume or self._reject_allocate)
+        self.vg = VolumeGrowth(
+            allocate_volume or self._reject_allocate,
+            on_register=lambda vid, dn: self._notify(vid, dn, deleted=False),
+        )
         self._subscribers: dict[str, LocationSubscriber] = {}
         self._admin_lock_token: Optional[str] = None
         self._admin_lock_ts = 0.0
         self._admin_lock_client = ""
         self._lock = threading.RLock()
+        # versioned VolumeLocation delta log for remote KeepConnected
+        # subscribers (wdclient long-polls /cluster/watch against this)
+        self._loc_version = 0
+        self._loc_log: deque = deque(maxlen=4096)
+        self._loc_cond = threading.Condition(self._lock)
 
     @staticmethod
     def _reject_allocate(dn, vid, option):
@@ -135,6 +144,7 @@ class Master:
         event = {
             "vid": vid,
             "url": dn.url(),
+            "public_url": dn.public_url or dn.url(),
             "deleted": deleted,
         }
         for fn in list(self._subscribers.values()):
@@ -142,6 +152,45 @@ class Master:
                 fn(event)
             except Exception:
                 pass
+        with self._loc_cond:
+            self._loc_version += 1
+            self._loc_log.append((self._loc_version, event))
+            self._loc_cond.notify_all()
+
+    def location_snapshot(self) -> dict:
+        """Full vid → [{url, public_url}] map from the current topology."""
+        locs: dict[int, list[dict]] = {}
+        with self._lock:
+            for dn in self.topo.data_nodes():
+                for vid in dn.volumes:
+                    locs.setdefault(vid, []).append(
+                        {"url": dn.url(), "public_url": dn.public_url or dn.url()}
+                    )
+        return {str(vid): v for vid, v in locs.items()}
+
+    def location_deltas(self, since: int, timeout: float = 0.0) -> dict:
+        """Events after version `since`; blocks up to `timeout` if none yet.
+
+        If `since` predates the retained log window, returns a full snapshot
+        instead (the caller must replace, not merge, its vid map) — the
+        KeepConnected stream's reconnect-resends-everything behavior
+        (master_grpc_server.go:99-120).
+        """
+        if since < 0:
+            with self._loc_cond:
+                version = self._loc_version
+            return {"version": version, "snapshot": self.location_snapshot()}
+        with self._loc_cond:
+            if self._loc_version == since and timeout > 0:
+                self._loc_cond.wait(timeout)
+            oldest = self._loc_log[0][0] if self._loc_log else self._loc_version + 1
+            if since + 1 < oldest and self._loc_version > since:
+                return {
+                    "version": self._loc_version,
+                    "snapshot": self.location_snapshot(),
+                }
+            events = [e for v, e in self._loc_log if v > since]
+            return {"version": self._loc_version, "events": events}
 
     # -- assignment (master_server_handlers.go:96-150) -----------------------
     def assign(

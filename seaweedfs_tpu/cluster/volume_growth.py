@@ -95,8 +95,11 @@ def find_empty_slots_for_one_volume(
 
 
 class VolumeGrowth:
-    def __init__(self, allocate_volume: AllocateVolumeFn):
+    def __init__(self, allocate_volume: AllocateVolumeFn, on_register=None):
         self.allocate_volume = allocate_volume
+        # called (vid, DataNode) after each successful placement so the
+        # master can push the new location to KeepConnected subscribers
+        self.on_register = on_register
 
     def grow_by_count(
         self, topo: Topology, option: VolumeGrowOption, count: int = 1
@@ -139,6 +142,8 @@ class VolumeGrowth:
             server.volumes[vid] = vi
             server.adjust_counts()
             topo._register_volume(vi, server)
+            if self.on_register is not None:
+                self.on_register(vid, server)
 
     @staticmethod
     def default_grow_count(rp: ReplicaPlacement) -> int:

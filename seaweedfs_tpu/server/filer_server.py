@@ -37,7 +37,24 @@ from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import MAX_INT64, view_from_chunks
 from ..filer.filer import Filer
 from ..filer.filerstore import NotFoundError, SqliteStore
+from ..wdclient import MasterClient
 from .http_util import JsonHandler, start_server
+
+
+class _VidLookup:
+    """operation.LookupCache-shaped facade over a wdclient MasterClient."""
+
+    def __init__(self, mc: MasterClient):
+        self._mc = mc
+
+    def lookup(self, vid: int) -> list[dict]:
+        return [
+            {"url": loc.url, "publicUrl": loc.public_url}
+            for loc in self._mc.lookup_volume(vid)
+        ]
+
+    def invalidate(self, vid: int) -> None:
+        self._mc.vid_map.invalidate(vid)
 
 
 class FilerServer:
@@ -74,7 +91,11 @@ class FilerServer:
         self.filer = Filer(
             store=SqliteStore(db_path), chunk_purger=self._purge_chunks
         )
-        self._lookup = operation.LookupCache(master_url)
+        # wdclient keeps the vid map warm off the master's KeepConnected
+        # feed (wdclient/masterclient.go); hot-path reads never block on a
+        # master round-trip unless the vid is genuinely unknown
+        self._master_client = MasterClient(master_url, f"filer@{host}:{port}").start()
+        self._lookup = _VidLookup(self._master_client)
         self._srv = None
         # cluster-sync loop-prevention signature (filer.go Signature)
         self.signature = random.getrandbits(31)
@@ -389,6 +410,7 @@ class FilerServer:
         return self
 
     def stop(self):
+        self._master_client.stop()
         if self._srv:
             self._srv.shutdown()
             self._srv.server_close()

@@ -190,6 +190,14 @@ class MasterServer:
     def _h_collections(self, h, path, q, body):
         return 200, {"collections": self.master.collection_list()}
 
+    def _h_watch(self, h, path, q, body):
+        # KeepConnected analog (master_grpc_server.go:178): long-poll for
+        # VolumeLocation deltas past `since`; falls back to a snapshot when
+        # the client is too far behind the retained log.
+        since = int(q.get("since", 0))
+        timeout = min(float(q.get("timeout", 10.0)), 30.0)
+        return 200, self.master.location_deltas(since, timeout)
+
     # -- liveness reaping (master_grpc_server.go:22-50 on stream close) ------
     def _reap_loop(self):
         while not self._stop.wait(self.node_timeout / 3):
@@ -219,6 +227,7 @@ class MasterServer:
                 ("GET", "/col/list", ms._h_collections),
                 ("POST", "/cluster/lock", ms._h_lock),
                 ("POST", "/cluster/unlock", ms._h_unlock),
+                ("GET", "/cluster/watch", ms._h_watch),
                 ("GET", "/dir/status", ms._h_status),
                 ("GET", "/cluster/status", ms._h_status),
             ]

@@ -1,0 +1,109 @@
+"""wdclient: vid-map cache + KeepConnected long-poll against a live master.
+
+Mirrors what weed/wdclient delivers: filers/gateways learn volume locations
+from the master's push feed and answer LookupFileId from cache.
+"""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.http_util import http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.wdclient import Location, MasterClient, VidMap
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("wd")
+    master = MasterServer(port=free_port(), node_timeout=30).start()
+    vs = VolumeServer(
+        [str(tmp / "v0")],
+        port=free_port(),
+        master_url=master.url,
+        max_volume_count=10,
+        pulse_seconds=0.5,
+        ec_backend="cpu",
+    ).start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        info = http_json("GET", f"http://{master.url}/dir/status")
+        if any(
+            r["nodes"]
+            for dc in info["topology"]["data_centers"]
+            for r in dc["racks"]
+        ):
+            break
+        time.sleep(0.1)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_vid_map_basics():
+    vm = VidMap()
+    vm.add_location(3, Location("a:1"))
+    vm.add_location(3, Location("b:2", "pub:2"))
+    vm.add_location(3, Location("a:1"))  # dedup
+    assert len(vm.lookup_volume(3)) == 2
+    vm.delete_location(3, "a:1")
+    assert [l.url for l in vm.lookup_volume(3)] == ["b:2"]
+    vm.delete_location(3, "b:2")
+    assert vm.lookup_volume(3) == []
+    vm.replace_all({"7": [{"url": "c:3", "public_url": "c:3"}]})
+    assert vm.lookup_volume_url(7) == "c:3"
+
+
+def test_watch_feed_populates_cache(cluster):
+    master, vs = cluster
+    mc = MasterClient(master.url, "t-watch").start()
+    try:
+        a = operation.assign(master.url)
+        operation.upload_data(a.url, a.fid, b"hello wdclient")
+        fid, vid = a.fid, int(a.fid.split(",")[0])
+        # the grow triggered by assign must arrive over the watch feed
+        deadline = time.time() + 5
+        while time.time() < deadline and not mc.vid_map.lookup_volume(vid):
+            time.sleep(0.05)
+        locs = mc.vid_map.lookup_volume(vid)
+        assert locs and locs[0].url == f"{vs.host}:{vs.port}"
+        urls = mc.lookup_file_id(fid)
+        assert urls == [f"http://{vs.host}:{vs.port}/{fid}"]
+    finally:
+        mc.stop()
+
+
+def test_snapshot_resync_when_behind(cluster):
+    master, vs = cluster
+    # a client "too far behind" (since=-1 with a non-empty log) gets a
+    # snapshot, not deltas — the reconnect-resends-everything contract
+    operation.assign(master.url)
+    r = http_json("GET", f"http://{master.url}/cluster/watch?since=-1&timeout=0")
+    assert "snapshot" in r or r["events"]
+    mc = MasterClient(master.url, "t-snap")
+    mc._apply(r)
+    assert len(mc.vid_map) > 0 or r.get("events") == []
+
+
+def test_lookup_miss_falls_back_to_master(cluster):
+    master, vs = cluster
+    a = operation.assign(master.url)
+    operation.upload_data(a.url, a.fid, b"miss path")
+    fid = a.fid
+    mc = MasterClient(master.url, "t-miss")  # NOT started: cache stays cold
+    urls = mc.lookup_file_id(fid)
+    assert urls == [f"http://{vs.host}:{vs.port}/{fid}"]
+    # and the result is now cached
+    vid = int(fid.split(",")[0])
+    assert mc.vid_map.lookup_volume(vid)
