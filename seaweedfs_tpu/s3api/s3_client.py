@@ -233,6 +233,103 @@ class S3Client:
         except urllib.error.HTTPError as e:
             return e.code, e.read(), dict(e.headers)
 
+    # -- multipart upload (for large-object streaming without buffering) -----
+    def initiate_multipart(self, bucket: str, key: str) -> str:
+        status, body, _ = self.request("POST", f"/{bucket}/{key}", query={"uploads": ""})
+        if status != 200:
+            raise RuntimeError(f"initiate multipart: HTTP {status}")
+        import re as _re
+
+        m = _re.search(rb"<UploadId>([^<]+)</UploadId>", body)
+        if not m:
+            raise RuntimeError("initiate multipart: no UploadId in response")
+        return m.group(1).decode()
+
+    def upload_part(
+        self, bucket: str, key: str, upload_id: str, part_number: int, body: bytes
+    ) -> str:
+        status, _, headers = self.request(
+            "PUT",
+            f"/{bucket}/{key}",
+            query={"partNumber": str(part_number), "uploadId": upload_id},
+            body=body,
+        )
+        if status != 200:
+            raise RuntimeError(f"upload part {part_number}: HTTP {status}")
+        return headers.get("ETag", headers.get("Etag", "")).strip('"')
+
+    def complete_multipart(
+        self, bucket: str, key: str, upload_id: str, parts: list[tuple[int, str]]
+    ):
+        xml = "<CompleteMultipartUpload>"
+        for num, etag in parts:
+            xml += f"<Part><PartNumber>{num}</PartNumber><ETag>{etag}</ETag></Part>"
+        xml += "</CompleteMultipartUpload>"
+        return self.request(
+            "POST",
+            f"/{bucket}/{key}",
+            query={"uploadId": upload_id},
+            body=xml.encode(),
+        )
+
+    def abort_multipart(self, bucket: str, key: str, upload_id: str):
+        return self.request(
+            "DELETE", f"/{bucket}/{key}", query={"uploadId": upload_id}
+        )
+
+    def put_object_from_file(
+        self, bucket: str, key: str, path: str, part_bytes: int = 64 * 1024 * 1024
+    ) -> int:
+        """Upload a file of any size with bounded memory: single PUT when it
+        fits one part, multipart otherwise. Returns the final HTTP status."""
+        import os as _os
+
+        size = _os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size <= part_bytes:
+                status, _, _ = self.put_object(bucket, key, f.read())
+                return status
+            upload_id = self.initiate_multipart(bucket, key)
+            try:
+                parts: list[tuple[int, str]] = []
+                num = 1
+                while True:
+                    chunk = f.read(part_bytes)
+                    if not chunk:
+                        break
+                    parts.append(
+                        (num, self.upload_part(bucket, key, upload_id, num, chunk))
+                    )
+                    num += 1
+                status, _, _ = self.complete_multipart(bucket, key, upload_id, parts)
+                return status
+            except Exception:
+                # don't strand uploaded parts on the backend
+                try:
+                    self.abort_multipart(bucket, key, upload_id)
+                except Exception:
+                    pass
+                raise
+
+    def get_object_to_file(
+        self, bucket: str, key: str, path: str, part_bytes: int = 64 * 1024 * 1024
+    ) -> int:
+        """Ranged-GET download with bounded memory; returns total bytes."""
+        status, _, headers = self.head_object(bucket, key)
+        if status != 200:
+            raise RuntimeError(f"head before ranged get: HTTP {status}")
+        size = int(headers.get("Content-Length", 0))
+        total = 0
+        with open(path, "wb") as f:
+            while total < size:
+                end = min(total + part_bytes, size) - 1
+                status, data, _ = self.get_object(bucket, key, rng=f"bytes={total}-{end}")
+                if status not in (200, 206) or not data:
+                    raise RuntimeError(f"ranged get at {total}: HTTP {status}")
+                f.write(data)
+                total += len(data)
+        return total
+
     # -- convenience ops -----------------------------------------------------
     def create_bucket(self, bucket: str):
         return self.request("PUT", f"/{bucket}")

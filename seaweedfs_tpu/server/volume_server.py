@@ -261,17 +261,61 @@ class VolumeServer:
     # -- tail / tier (volume_grpc_tail.go, volume_grpc_tier_*.go) ------------
     def _h_tail(self, h, path, q, body):
         """Binary needle stream: frames of [4B len][record bytes] for records
-        appended after since_ns (VolumeTailSender)."""
+        appended after since_ns (VolumeTailSender). Paged: at most max_bytes
+        of frames per response; callers loop until an empty body."""
         v = self.store.find_volume(int(q["volume"]))
         if v is None:
             return 404, {"error": "volume not found"}
         since = int(q.get("since_ns", 0))
+        max_bytes = int(q.get("max_bytes", 8 * 1024 * 1024))
         out = bytearray()
+        last_ns = since
         for n in v.tail_needles(since):
             blob = n.to_bytes(v.version)
             out += len(blob).to_bytes(4, "big") + blob
-        h.extra_headers = {"X-Volume-Version": str(v.version)}
+            last_ns = n.append_at_ns
+            if len(out) >= max_bytes:
+                break
+        h.extra_headers = {
+            "X-Volume-Version": str(v.version),
+            "X-Last-Append-Ns": str(last_ns),
+        }
         return 200, bytes(out)
+
+    def _h_volume_status(self, h, path, q, body):
+        """Per-volume status for backup/copy clients (volume.go FileStat +
+        superblock fields)."""
+        v = self.store.find_volume(int(q["volume"]))
+        if v is None:
+            return 404, {"error": "volume not found"}
+        return 200, {
+            "volume": v.id,
+            "size": v.size(),
+            "version": v.version,
+            "compaction_revision": v.super_block.compaction_revision,
+            "last_append_at_ns": v.last_append_at_ns,
+            "file_count": v.file_count(),
+            "read_only": v.read_only,
+        }
+
+    def _h_incremental_copy(self, h, path, q, body):
+        """Raw .dat bytes from `offset`, at most `max_bytes` per response
+        (VolumeIncrementalCopy rpc, volume_grpc_copy_incremental.go). The
+        client appends verbatim and rebuilds its index from the new region."""
+        v = self.store.find_volume(int(q["volume"]))
+        if v is None:
+            return 404, {"error": "volume not found"}
+        offset = int(q.get("offset", 0))
+        max_bytes = min(int(q.get("max_bytes", 8 * 1024 * 1024)), 64 * 1024 * 1024)
+        size = v.size()
+        n = max(0, min(size - offset, max_bytes))
+        data = v.data_backend.read_at(offset, n) if n else b""
+        h.extra_headers = {
+            "X-Volume-Version": str(v.version),
+            "X-Dat-Size": str(size),
+            "X-Compaction-Revision": str(v.super_block.compaction_revision),
+        }
+        return 200, data
 
     def _h_tier_upload(self, h, path, q, body):
         v = self.store.find_volume(int(q["volume"]))
@@ -283,6 +327,7 @@ class VolumeServer:
             access_key=q.get("accessKey", ""),
             secret_key=q.get("secretKey", ""),
             keep_local=q.get("keepLocal") == "true",
+            skip_upload=q.get("skipUpload") == "true",
         )
         return 200, info
 
@@ -535,6 +580,8 @@ class VolumeServer:
                 ("POST", "/admin/vacuum", vs._h_vacuum),
                 ("POST", "/admin/volume_copy", vs._h_volume_copy),
                 ("GET", "/admin/tail", vs._h_tail),
+                ("GET", "/admin/volume_status", vs._h_volume_status),
+                ("GET", "/admin/incremental_copy", vs._h_incremental_copy),
                 ("POST", "/admin/tier_upload", vs._h_tier_upload),
                 ("POST", "/admin/tier_download", vs._h_tier_download),
                 ("POST", "/admin/ec/generate", vs._h_ec_generate),

@@ -335,12 +335,17 @@ def volume_tier_upload(
     locs = env.volume_locations(vid)
     if not locs:
         raise RuntimeError(f"volume {vid} not found")
+    # one replica uploads the bytes (command_volume_tier_upload.go uploads
+    # from a single location); the others seal to the same remote object
+    # with keepLocal semantics decided per deployment — here they simply
+    # point their .tier descriptor at the object the first upload created.
     results = []
-    for loc in locs:
+    for i, loc in enumerate(locs):
         r = http_json(
             "POST",
             f"http://{loc}/admin/tier_upload?volume={vid}&endpoint={endpoint}"
-            f"&bucket={bucket}&keepLocal={'true' if keep_local else 'false'}",
+            f"&bucket={bucket}&keepLocal={'true' if keep_local else 'false'}"
+            f"&skipUpload={'true' if i > 0 else 'false'}",
         )
         results.append({"server": loc} | r)
     return {"tiered": results}

@@ -100,7 +100,12 @@ class Volume:
             with open(base + ".tier") as f:
                 info = _json.load(f)
             self.data_backend: BackendStorageFile = RemoteS3File(
-                info["endpoint"], info["bucket"], info["key"], size=info["size"]
+                info["endpoint"],
+                info["bucket"],
+                info["key"],
+                info.get("access_key", ""),
+                info.get("secret_key", ""),
+                size=info["size"],
             )
             self.read_only = True
         else:
@@ -383,11 +388,14 @@ class Volume:
 
     # -- sequential scan (for rebuild/vacuum/export) -------------------------
     def scan_needles(
-        self, verify_crc: bool = False
+        self, verify_crc: bool = False, start_offset: Optional[int] = None
     ) -> Iterator[tuple[Needle, int, int]]:
-        """Yield (needle, offset, total_len) for every record in the .dat."""
+        """Yield (needle, offset, total_len) for every record in the .dat,
+        optionally starting mid-file (ScanVolumeFileFrom)."""
         size = self.data_backend.size()
-        offset = self.super_block.block_size()
+        offset = (
+            start_offset if start_offset is not None else self.super_block.block_size()
+        )
         version = self.version
         while offset + NEEDLE_HEADER_SIZE <= size:
             hdr = self.data_backend.read_at(offset, NEEDLE_HEADER_SIZE)
@@ -428,35 +436,63 @@ class Volume:
         access_key: str = "",
         secret_key: str = "",
         keep_local: bool = False,
+        skip_upload: bool = False,
     ) -> dict:
         """Seal the volume and move its .dat to an S3-compatible backend,
         keeping .idx local; reads continue through ranged GETs
-        (volume_tier.go + volume_grpc_tier_upload.go)."""
+        (volume_tier.go + volume_grpc_tier_upload.go). With skip_upload a
+        replica verifies the object another replica already uploaded and
+        just writes its own .tier descriptor."""
         import json as _json
 
         from .backend import DiskFile, RemoteS3File
         from ..s3api.s3_client import S3Client
 
         with self._lock:
+            was_read_only = self.read_only
             self.read_only = True
-            self.data_backend.sync()
-            key = f"{self.collection or 'default'}_{self.id}.dat"
-            size = self.data_backend.size()
-            client = S3Client(endpoint, access_key, secret_key)
-            client.create_bucket(bucket)  # idempotent-ish; 409 is fine
-            data = self.data_backend.read_at(0, size)
-            status, _, _ = client.put_object(bucket, key, data)
-            if status != 200:
-                raise VolumeError(f"tier upload failed: HTTP {status}")
+            try:
+                self.data_backend.sync()
+                key = f"{self.collection or 'default'}_{self.id}.dat"
+                size = self.data_backend.size()
+                local = self.file_name() + ".dat"
+                client = S3Client(endpoint, access_key, secret_key)
+                if skip_upload:
+                    status, _, headers = client.head_object(bucket, key)
+                    if status != 200:
+                        raise VolumeError(
+                            f"tier object {bucket}/{key} missing: HTTP {status}"
+                        )
+                    remote_size = int(headers.get("Content-Length", -1))
+                    if remote_size != size:
+                        raise VolumeError(
+                            f"tier object size {remote_size} != local {size}"
+                        )
+                else:
+                    client.create_bucket(bucket)  # idempotent-ish; 409 is fine
+                    # bounded memory: multipart for anything past one part
+                    status = client.put_object_from_file(bucket, key, local)
+                    if status != 200:
+                        raise VolumeError(f"tier upload failed: HTTP {status}")
+            except Exception:
+                # the seal only sticks once the upload committed
+                self.read_only = was_read_only
+                raise
+            # creds ride in the descriptor (0600) so the volume still opens
+            # after a server restart; the reference keeps them in the named
+            # backend config the .vif points at (backend/s3_backend)
             info = {
                 "endpoint": endpoint,
                 "bucket": bucket,
                 "key": key,
                 "size": size,
+                "access_key": access_key,
+                "secret_key": secret_key,
             }
-            with open(self.tier_file(), "w") as f:
+            tf = self.tier_file()
+            with open(tf, "w") as f:
                 _json.dump(info, f)
-            local = self.file_name() + ".dat"
+            os.chmod(tf, 0o600)
             self.data_backend.close()
             self.data_backend = RemoteS3File(
                 endpoint, bucket, key, access_key, secret_key, size=size
@@ -477,13 +513,25 @@ class Volume:
         with self._lock:
             with open(self.tier_file()) as f:
                 info = _json.load(f)
-            client = S3Client(info["endpoint"], access_key, secret_key)
-            status, data, _ = client.get_object(info["bucket"], info["key"])
-            if status != 200:
-                raise VolumeError(f"tier download failed: HTTP {status}")
+            client = S3Client(
+                info["endpoint"],
+                access_key or info.get("access_key", ""),
+                secret_key or info.get("secret_key", ""),
+            )
             local = self.file_name() + ".dat"
-            with open(local + ".tmp", "wb") as f:
-                f.write(data)
+            try:
+                # ranged-GET pages straight to disk: no whole-volume buffer
+                got = client.get_object_to_file(
+                    info["bucket"], info["key"], local + ".tmp"
+                )
+                if got != info["size"]:
+                    raise VolumeError(
+                        f"tier download: got {got} bytes, want {info['size']}"
+                    )
+            except Exception:
+                if os.path.exists(local + ".tmp"):
+                    os.unlink(local + ".tmp")
+                raise
             os.replace(local + ".tmp", local)
             self.data_backend.close()
             self.data_backend = DiskFile(local)

@@ -1,19 +1,37 @@
-"""Incremental volume backup by append-timestamp tail (reference
-`weed/storage/volume_backup.go` + `weed/command/backup.go`): a local copy
-volume tracks its own last_append_at_ns; each run fetches only records
-appended since then and replays them — size-0 tombstones as deletes,
-everything else as timestamp-preserving writes — so repeated runs converge
-and resume."""
+"""Incremental volume backup by raw .dat tail copy.
+
+Reference: `weed/storage/volume_backup.go` (`IncrementalBackup`) +
+`weed/command/backup.go` (`runBackup`): the local copy is a byte-for-byte
+prefix of the source volume. Each run
+
+1. compares the source's compaction revision with the local superblock —
+   on mismatch the local copy is wiped and re-copied from offset 0 (the
+   reference's "compaction occurred, switch to the new revision" path),
+2. appends raw `.dat` bytes from the local size to the source's EOF in
+   bounded pages (VolumeIncrementalCopy rpc semantics), and
+3. rebuilds the needle-map entries for the newly copied region only
+   (ScanVolumeFileFrom + VolumeFileScanner4GenIdx: size>0 records are
+   puts, size-0 records are tombstones).
+
+Byte-verbatim copying sidesteps needle-level replay entirely: timestamps,
+tombstones, and zero-length files are preserved exactly, and a run that
+transfers nothing leaves the local copy untouched — repeated runs converge.
+"""
 
 from __future__ import annotations
+
+import os
 
 from ..server.http_util import http_bytes, http_json
 from .needle import Needle, parse_needle_header
 from .needle import NEEDLE_HEADER_SIZE  # re-exported there
-from .volume import Volume
+from .volume import Volume, volume_file_name
+
+PAGE_BYTES = 8 * 1024 * 1024
 
 
 def parse_tail_frames(blob: bytes, version: int) -> list[Needle]:
+    """Decode the framed needle stream of /admin/tail (VolumeTailSender)."""
     out = []
     pos = 0
     while pos + 4 <= len(blob):
@@ -35,30 +53,141 @@ def backup_volume(
     if not locs:
         raise RuntimeError(f"volume {vid} not found on any server")
     src = locs[0]["url"]
-    local = Volume(directory, collection, vid)
+    st = http_json("GET", f"http://{src}/admin/volume_status?volume={vid}")
+    if st.get("error"):
+        raise RuntimeError(f"volume status from {src}: {st['error']}")
+
+    base = volume_file_name(directory, collection, vid)
+    os.makedirs(directory, exist_ok=True)
+    wiped = False
+    if os.path.exists(base + ".dat"):
+        local = Volume(directory, collection, vid, create_if_missing=False)
+        local_rev = local.super_block.compaction_revision
+        local.close()
+        if local_rev != st["compaction_revision"]:
+            # source was compacted since our last pass: our bytes are no
+            # longer a prefix of its .dat — start over (volume_backup.go
+            # compaction revision mismatch → full copy)
+            for ext in (".dat", ".idx"):
+                if os.path.exists(base + ext):
+                    os.unlink(base + ext)
+            wiped = True
+
+    start = os.path.getsize(base + ".dat") if os.path.exists(base + ".dat") else 0
+    if start == 0 and os.path.exists(base + ".idx"):
+        os.unlink(base + ".idx")  # stale index with no .dat: force rebuild
+    if start:
+        # Resume from the last INDEXED record, not the raw .dat size: a
+        # previous run may have crashed after fsyncing copied bytes but
+        # before _index_region ran. Those unindexed tail bytes are cut and
+        # re-copied so every backup byte always has an index entry.
+        indexed_end = _indexed_end(base)
+        if indexed_end < start:
+            with open(base + ".dat", "r+b") as f:
+                f.truncate(indexed_end)
+            start = indexed_end
+    copied = 0
+    with open(base + ".dat", "ab") as f:
+        offset = start
+        while True:
+            status, page = http_bytes(
+                "GET",
+                f"http://{src}/admin/incremental_copy?volume={vid}"
+                f"&offset={offset}&max_bytes={PAGE_BYTES}",
+            )
+            if status != 200:
+                raise RuntimeError(f"incremental copy from {src}: HTTP {status}")
+            if not page:
+                break
+            f.write(page)
+            offset += len(page)
+            copied += len(page)
+        f.flush()
+        os.fsync(f.fileno())
+
+    # Index the new region BEFORE opening the Volume: size-0 records are
+    # tombstones (VolumeFileScanner4GenIdx semantics — the reference makes
+    # the same size==0 ⇒ delete call). Volume.__init__ truncates any .dat
+    # tail past the last indexed record, so the .idx entries must land first.
+    writes = deletes = 0
+    fresh = start == 0  # Volume.__init__ rebuilds the whole .idx in this case
+    if not fresh and copied:
+        writes, deletes = _index_region(base, start)
+    local = Volume(directory, collection, vid, create_if_missing=False)
     try:
-        since = local.last_append_at_ns
-        status, blob = http_bytes(
-            "GET", f"http://{src}/admin/tail?volume={vid}&since_ns={since}"
-        )
-        if status != 200:
-            raise RuntimeError(f"tail from {src}: HTTP {status}")
-        writes = deletes = 0
-        for n in parse_tail_frames(blob, local.version):
-            if n.size == 0 and not n.data:
-                local.delete_needle(n, append_at_ns=n.append_at_ns)
-                deletes += 1
-            else:
-                local.write_needle(n, append_at_ns=n.append_at_ns)
-                writes += 1
-        local.sync()
+        if fresh:
+            writes = local.file_count()
+            deletes = local.deleted_count()
         return {
             "volume": vid,
             "from": src,
-            "since_ns": since,
+            "start_offset": start,
+            "copied_bytes": copied,
             "writes": writes,
             "deletes": deletes,
+            "wiped": wiped,
             "file_count": local.file_count(),
         }
     finally:
         local.close()
+
+
+def _indexed_end(base: str) -> int:
+    """End offset of the last record the .idx knows about (appends are
+    in offset order, so the last entry is the highest)."""
+    import struct
+
+    from . import idx as idx_mod
+    from .needle import get_actual_size
+    from .super_block import SUPER_BLOCK_SIZE, SuperBlock
+
+    with open(base + ".dat", "rb") as f:
+        head = f.read(SUPER_BLOCK_SIZE)
+        extra = struct.unpack(">H", head[6:8])[0]
+        sb = SuperBlock.from_bytes(head + f.read(extra))
+    if not os.path.exists(base + ".idx"):
+        return sb.block_size()
+    entry_size = 8 + idx_mod.OFFSET_SIZE + 4
+    idx_size = os.path.getsize(base + ".idx")
+    idx_size -= idx_size % entry_size
+    if idx_size == 0:
+        return sb.block_size()
+    with open(base + ".idx", "rb") as f:
+        f.seek(idx_size - entry_size)
+        _, aoff, size = idx_mod.unpack_entry(f.read(entry_size))
+    return aoff + get_actual_size(max(size, 0), sb.version)
+
+
+def _index_region(base: str, start: int) -> tuple[int, int]:
+    """Append .idx entries for every record at offset ≥ start in the .dat
+    (ScanVolumeFileFrom + GenIdx). Returns (writes, deletes)."""
+    import struct
+
+    from . import idx as idx_mod
+    from .needle import needle_body_length
+    from .super_block import SUPER_BLOCK_SIZE, SuperBlock
+
+    writes = deletes = 0
+    with open(base + ".dat", "rb") as f, open(base + ".idx", "ab") as out:
+        head = f.read(SUPER_BLOCK_SIZE)
+        extra = struct.unpack(">H", head[6:8])[0]
+        sb = SuperBlock.from_bytes(head + f.read(extra))
+        version = sb.version
+        fsize = os.path.getsize(base + ".dat")
+        offset = max(start, sb.block_size())
+        while offset + NEEDLE_HEADER_SIZE <= fsize:
+            f.seek(offset)
+            hdr = f.read(NEEDLE_HEADER_SIZE)
+            _, nid, nsize = parse_needle_header(hdr)
+            body_len = needle_body_length(nsize if nsize > 0 else 0, version)
+            total = NEEDLE_HEADER_SIZE + body_len
+            if offset + total > fsize:
+                break
+            if nsize > 0:
+                out.write(idx_mod.pack_entry(nid, offset, nsize))
+                writes += 1
+            else:
+                out.write(idx_mod.pack_entry(nid, offset, -1))
+                deletes += 1
+            offset += total
+    return writes, deletes

@@ -148,6 +148,11 @@ class MasterClient:
                     timeout=self.poll_timeout + 20.0,
                 )
             except Exception:
+                r = None
+            if r is None or r.get("error") or "version" not in r:
+                # transport failure OR an error-shaped body (http_json maps
+                # HTTP errors to {'error': ...} instead of raising): back
+                # off and resync from a fresh snapshot
                 self.current_master = None
                 self._stop.wait(0.5)
                 continue
@@ -178,7 +183,10 @@ class MasterClient:
         except Exception:
             return []
         for m in r.get("locations", ()):
-            self.vid_map.add_location(vid, Location(m["url"], m.get("publicUrl", "")))
+            self.vid_map.add_location(
+                vid,
+                Location(m["url"], m.get("public_url") or m.get("publicUrl", "")),
+            )
         return self.vid_map.lookup_volume(vid)
 
     def lookup_file_id(self, fid: str) -> list[str]:
