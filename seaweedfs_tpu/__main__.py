@@ -278,6 +278,31 @@ def cmd_filer_replicate(args):
             time.sleep(1.0)
 
 
+def cmd_mount(args):
+    """Continuous local-dir ⇄ filer sync (weed mount, FUSE-less)."""
+    from .mount.sync import MountSync
+
+    ms = MountSync(
+        args.filer,
+        args.filer_path,
+        args.dir,
+        scan_seconds=args.scan_seconds,
+    ).start()
+    print(f"mounted {args.filer}{args.filer_path} ⇄ {args.dir}")
+    try:
+        _wait_forever()
+    finally:
+        ms.stop()
+
+
+def cmd_filer_copy(args):
+    """Upload a local tree to the filer (weed filer.copy)."""
+    from .mount.sync import copy_to_filer
+
+    n = copy_to_filer(args.dir, args.filer, args.filer_path)
+    print(f"copied {n} files from {args.dir} to {args.filer}{args.filer_path}")
+
+
 def cmd_watch(args):
     """Tail a filer's meta event stream (weed watch)."""
     import json as _json
@@ -472,6 +497,19 @@ def main(argv=None):
     frep.add_argument("-s3.accessKey", dest="s3_access_key", default="")
     frep.add_argument("-s3.secretKey", dest="s3_secret_key", default="")
     frep.set_defaults(fn=cmd_filer_replicate)
+
+    mnt = sub.add_parser("mount", help="sync a local dir with a filer dir")
+    mnt.add_argument("-filer", dest="filer", default="127.0.0.1:8888")
+    mnt.add_argument("-filer.path", dest="filer_path", default="/")
+    mnt.add_argument("-dir", dest="dir", required=True)
+    mnt.add_argument("-scanSeconds", dest="scan_seconds", type=float, default=1.0)
+    mnt.set_defaults(fn=cmd_mount)
+
+    fcp = sub.add_parser("filer.copy", help="upload a local tree to the filer")
+    fcp.add_argument("-filer", dest="filer", default="127.0.0.1:8888")
+    fcp.add_argument("-filer.path", dest="filer_path", default="/")
+    fcp.add_argument("dir")
+    fcp.set_defaults(fn=cmd_filer_copy)
 
     w = sub.add_parser("watch", help="tail filer meta events")
     w.add_argument("-filer", default="127.0.0.1:8888")

@@ -120,6 +120,15 @@ class FilerClient:
     def rename(self, old: str, new: str) -> None:
         http_json("POST", self._u(old, **{"mv.to": new}))
 
+    def assign(self, count: int = 1, collection: str = "", ttl: str = "") -> dict:
+        """AssignVolume through the filer (pb/filer.proto AssignVolume) —
+        write-through clients (mount) get fids without master access."""
+        return http_json(
+            "GET",
+            self.base
+            + f"/_assign?count={count}&collection={collection}&ttl={ttl}",
+        )
+
     # -- meta subscribe / kv / status ----------------------------------------
     def status(self) -> dict:
         return http_json("GET", self.base + "/_status")

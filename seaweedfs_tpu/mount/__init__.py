@@ -1,0 +1,16 @@
+"""Mount layer: a filesystem view over the filer, FUSE-less.
+
+Reference: `weed/filesys/` (3,267 LoC) + `weed/command/mount_std.go`. The
+reference exposes the filer through the kernel via FUSE; this build exposes
+the same machinery as an in-process virtual filesystem (`WFS`) plus a
+local-directory synchronizer (`sync`) — the pieces a FUSE binding would
+call (lookup/read/write/flush via dirty-page intervals, meta cache kept
+fresh by the filer's metadata subscription) are all here and tested
+without requiring kernel support in the build environment.
+"""
+
+from .dirty_pages import ContinuousIntervals
+from .meta_cache import MetaCache
+from .wfs import WFS, FileHandle
+
+__all__ = ["WFS", "FileHandle", "ContinuousIntervals", "MetaCache"]

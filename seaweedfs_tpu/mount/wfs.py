@@ -1,0 +1,256 @@
+"""WFS: the virtual filesystem over the filer.
+
+Reference: `weed/filesys/wfs.go:55` (the FUSE fs object), `file.go`/
+`filehandle.go` (open-file state + dirty pages), `wfs_write.go`
+(saveDataAsChunk: assign fid → upload → append chunk), `dir.go`
+(directory ops). FUSE wiring is replaced by a plain Python API with the
+same operation set; a FUSE binding would be a thin adapter over this.
+
+Write path: writes land in ContinuousIntervals; any continuous run that
+reaches chunk_size is eagerly uploaded and committed; flush() uploads the
+rest and commits the entry (chunk list) to the filer. Read path: committed
+bytes come from the filer (ranged GET), then still-dirty intervals overlay
+them — read-your-writes without waiting for a flush.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .. import operation
+from ..filer.client import FilerClient
+from ..filer.entry import Entry, FileChunk
+from .dirty_pages import ContinuousIntervals
+from .meta_cache import MetaCache
+
+
+class WfsError(Exception):
+    pass
+
+
+class WFS:
+    def __init__(
+        self,
+        filer_url: str,
+        chunk_size: int = 8 * 1024 * 1024,
+        collection: str = "",
+        ttl: str = "",
+        meta_cache_db: str = ":memory:",
+        use_meta_cache: bool = True,
+    ):
+        self.client = FilerClient(filer_url)
+        self.chunk_size = chunk_size
+        self.collection = collection
+        self.ttl = ttl
+        self.meta_cache: Optional[MetaCache] = None
+        if use_meta_cache:
+            self.meta_cache = MetaCache(filer_url, meta_cache_db).start()
+
+    def close(self) -> None:
+        if self.meta_cache:
+            self.meta_cache.stop()
+
+    # -- directory ops (filesys/dir.go) --------------------------------------
+    def stat(self, path: str) -> Entry:
+        e = (
+            self.meta_cache.lookup(path)
+            if self.meta_cache
+            else self._remote_entry(path)
+        )
+        if e is None:
+            raise FileNotFoundError(path)
+        return e
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.stat(path)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def _remote_entry(self, path: str) -> Optional[Entry]:
+        d = self.client.get_entry(path)
+        return Entry.from_dict(d) if d else None
+
+    def listdir(self, path: str) -> list[Entry]:
+        if self.meta_cache:
+            return self.meta_cache.list_dir(path)
+        return [Entry.from_dict(d) for d in self.client.list(path)]
+
+    def mkdir(self, path: str, mode: int = 0o775) -> None:
+        self.client.mkdir(path)
+        if self.meta_cache:
+            self.meta_cache.invalidate(path)
+
+    def unlink(self, path: str) -> None:
+        self.client.delete(path)
+        if self.meta_cache:
+            self.meta_cache.invalidate(path)
+
+    def rmdir(self, path: str, recursive: bool = False) -> None:
+        self.client.delete(path, recursive=recursive)
+        if self.meta_cache:
+            self.meta_cache.invalidate(path)
+
+    def rename(self, old: str, new: str) -> None:
+        self.client.rename(old, new)
+        if self.meta_cache:
+            self.meta_cache.invalidate(old)
+            self.meta_cache.invalidate(new)
+
+    # -- file ops ------------------------------------------------------------
+    def open(self, path: str, mode: str = "r") -> "FileHandle":
+        """Modes: r, r+, w (truncate/create), a (append/create)."""
+        entry: Optional[Entry] = None
+        try:
+            entry = self.stat(path)
+        except FileNotFoundError:
+            pass
+        if mode in ("r", "r+") and entry is None:
+            raise FileNotFoundError(path)
+        if entry is not None and entry.is_directory:
+            raise IsADirectoryError(path)
+        if mode == "w" or entry is None:
+            entry = Entry(full_path=path, is_directory=False, mode=0o660)
+            entry.chunks = []
+            if mode in ("w", "a", "r+"):
+                # commit the (possibly truncating) create immediately so
+                # concurrent readers see a consistent entry
+                self.client.create_entry(path, entry.to_dict())
+                if self.meta_cache:
+                    self.meta_cache.invalidate(path)
+        return FileHandle(self, path, entry, mode)
+
+    # convenience one-shots
+    def write_file(self, path: str, data: bytes) -> None:
+        with self.open(path, "w") as f:
+            f.write(0, data)
+
+    def read_file(self, path: str) -> bytes:
+        with self.open(path, "r") as f:
+            return f.read(0, f.size())
+
+    # -- chunk upload (wfs_write.go saveDataAsChunk) -------------------------
+    def save_data_as_chunks(self, data: bytes, base_offset: int) -> list[FileChunk]:
+        chunks = []
+        pos = 0
+        while pos < len(data):
+            piece = data[pos : pos + self.chunk_size]
+            a = self.client.assign(collection=self.collection, ttl=self.ttl)
+            if a.get("error"):
+                raise WfsError(f"assign: {a['error']}")
+            operation.upload_data(a["url"], a["fid"], piece, jwt=a.get("auth", ""))
+            chunks.append(
+                FileChunk(
+                    file_id=a["fid"],
+                    offset=base_offset + pos,
+                    size=len(piece),
+                    mtime=time.time_ns(),
+                )
+            )
+            pos += len(piece)
+        return chunks
+
+
+class FileHandle:
+    """Open-file state (filesys/filehandle.go): dirty pages + entry view."""
+
+    def __init__(self, wfs: WFS, path: str, entry: Entry, mode: str):
+        self.wfs = wfs
+        self.path = path
+        self.entry = entry
+        self.mode = mode
+        self.dirty = ContinuousIntervals()
+        self._lock = threading.RLock()
+        self._closed = False
+
+    # -- context manager -----------------------------------------------------
+    def __enter__(self) -> "FileHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def size(self) -> int:
+        with self._lock:
+            return max(self.entry.file_size(), self.dirty.max_stop())
+
+    def append_offset(self) -> int:
+        return self.size()
+
+    # -- write path ----------------------------------------------------------
+    def write(self, offset: int, data: bytes) -> int:
+        if self.mode == "r":
+            raise WfsError("file not open for writing")
+        with self._lock:
+            if self.mode == "a":
+                offset = self.size()
+            self.dirty.add_interval(offset, data, time.time_ns())
+            # eager flush of full chunk runs (dirty_pages.go)
+            while True:
+                iv = self.dirty.pop_largest_if_over(self.wfs.chunk_size)
+                if iv is None:
+                    break
+                self._commit_chunks(
+                    self.wfs.save_data_as_chunks(iv.data, iv.start)
+                )
+            return len(data)
+
+    def _commit_chunks(self, new_chunks: list[FileChunk]) -> None:
+        self.entry.chunks.extend(new_chunks)
+        self.entry.mtime = int(time.time())
+        self.wfs.client.create_entry(self.path, self.entry.to_dict())
+        if self.wfs.meta_cache:
+            self.wfs.meta_cache.invalidate(self.path)
+
+    def flush(self) -> None:
+        with self._lock:
+            ivs = self.dirty.pop_all()
+            if not ivs:
+                return
+            chunks: list[FileChunk] = []
+            for iv in ivs:
+                chunks.extend(self.wfs.save_data_as_chunks(iv.data, iv.start))
+            self._commit_chunks(chunks)
+
+    def truncate(self, length: int = 0) -> None:
+        """Supported: truncate-to-zero (drop all chunks) and logical
+        extension; mid-file truncation would need chunk clipping."""
+        with self._lock:
+            if length == 0:
+                self.dirty = ContinuousIntervals()
+                self.entry.chunks = []
+                self.wfs.client.create_entry(self.path, self.entry.to_dict())
+                if self.wfs.meta_cache:
+                    self.wfs.meta_cache.invalidate(self.path)
+            elif length < self.size():
+                raise WfsError("mid-file truncate not supported")
+
+    # -- read path -----------------------------------------------------------
+    def read(self, offset: int, size: int) -> bytes:
+        with self._lock:
+            end = min(offset + size, self.size())
+            if end <= offset:
+                return b""
+            want = end - offset
+            base = bytearray(want)
+            committed = self.entry.file_size()
+            if offset < committed:
+                hi = min(end, committed) - 1
+                status, data, _ = self.wfs.client.get_object(
+                    self.path, rng=f"bytes={offset}-{hi}"
+                )
+                if status not in (200, 206):
+                    raise WfsError(f"read {self.path}: HTTP {status}")
+                base[: len(data)] = data
+            # overlay still-dirty bytes (read-your-writes)
+            for lo, data in self.dirty.read_data_at(offset, want):
+                base[lo - offset : lo - offset + len(data)] = data
+            return bytes(base)
+
+    def close(self) -> None:
+        if not self._closed:
+            self.flush()
+            self._closed = True

@@ -110,6 +110,28 @@ class FilerServer:
         t.start()
 
     # -- meta subscribe / kv / status (filer_pb rpc analogs) -----------------
+    def _h_assign(self, h, path, q, body):
+        """AssignVolume rpc analog (pb/filer.proto): mount and other write-
+        through clients get fids + upload urls without talking to the
+        master themselves."""
+        try:
+            a = operation.assign(
+                self.master_url,
+                count=int(q.get("count", 1)),
+                collection=q.get("collection", self.collection),
+                replication=q.get("replication", self.replication),
+                ttl=q.get("ttl", ""),
+            )
+        except Exception as e:
+            return 500, {"error": str(e)}
+        return 200, {
+            "fid": a.fid,
+            "url": a.url,
+            "publicUrl": a.public_url,
+            "count": a.count,
+            "auth": a.auth,
+        }
+
     def _h_meta_events(self, h, path, q, body):
         """SubscribeMetadata analog: poll events after since_ns
         (server/filer_grpc_server_sub_meta.go)."""
@@ -392,6 +414,7 @@ class FilerServer:
 
         class Handler(JsonHandler):
             routes = [
+                ("GET", "/_assign", fs._h_assign),
                 ("GET", "/_meta/events", fs._h_meta_events),
                 ("GET", "/_status", fs._h_status),
                 ("GET", "/metrics", fs._h_metrics),

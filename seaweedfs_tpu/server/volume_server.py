@@ -270,12 +270,18 @@ class VolumeServer:
         max_bytes = int(q.get("max_bytes", 8 * 1024 * 1024))
         out = bytearray()
         last_ns = since
+        full = False
         for n in v.tail_needles(since):
+            if full and n.append_at_ns != last_ns:
+                break
             blob = n.to_bytes(v.version)
             out += len(blob).to_bytes(4, "big") + blob
             last_ns = n.append_at_ns
+            # once over the page budget, still finish the current ns group:
+            # resume is `append_at_ns > since`, so splitting a group of
+            # equal timestamps across pages would silently drop its tail
             if len(out) >= max_bytes:
-                break
+                full = True
         h.extra_headers = {
             "X-Volume-Version": str(v.version),
             "X-Last-Append-Ns": str(last_ns),

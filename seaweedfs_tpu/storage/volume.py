@@ -388,14 +388,11 @@ class Volume:
 
     # -- sequential scan (for rebuild/vacuum/export) -------------------------
     def scan_needles(
-        self, verify_crc: bool = False, start_offset: Optional[int] = None
+        self, verify_crc: bool = False
     ) -> Iterator[tuple[Needle, int, int]]:
-        """Yield (needle, offset, total_len) for every record in the .dat,
-        optionally starting mid-file (ScanVolumeFileFrom)."""
+        """Yield (needle, offset, total_len) for every record in the .dat."""
         size = self.data_backend.size()
-        offset = (
-            start_offset if start_offset is not None else self.super_block.block_size()
-        )
+        offset = self.super_block.block_size()
         version = self.version
         while offset + NEEDLE_HEADER_SIZE <= size:
             hdr = self.data_backend.read_at(offset, NEEDLE_HEADER_SIZE)
@@ -490,16 +487,20 @@ class Volume:
                 "secret_key": secret_key,
             }
             tf = self.tier_file()
-            with open(tf, "w") as f:
+            fd = os.open(tf, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
                 _json.dump(info, f)
-            os.chmod(tf, 0o600)
             self.data_backend.close()
             self.data_backend = RemoteS3File(
                 endpoint, bucket, key, access_key, secret_key, size=size
             )
             if not keep_local:
                 os.unlink(local)
-            return info
+            # never echo credentials back to callers (the handler serializes
+            # this dict into an HTTP response)
+            return {
+                k: v for k, v in info.items() if k not in ("access_key", "secret_key")
+            }
 
     def tier_download(
         self, access_key: str = "", secret_key: str = ""

@@ -132,19 +132,24 @@ def backup_volume(
         local.close()
 
 
-def _indexed_end(base: str) -> int:
-    """End offset of the last record the .idx knows about (appends are
-    in offset order, so the last entry is the highest)."""
+def _read_super_block(base: str):
     import struct
 
-    from . import idx as idx_mod
-    from .needle import get_actual_size
     from .super_block import SUPER_BLOCK_SIZE, SuperBlock
 
     with open(base + ".dat", "rb") as f:
         head = f.read(SUPER_BLOCK_SIZE)
         extra = struct.unpack(">H", head[6:8])[0]
-        sb = SuperBlock.from_bytes(head + f.read(extra))
+        return SuperBlock.from_bytes(head + f.read(extra))
+
+
+def _indexed_end(base: str) -> int:
+    """End offset of the last record the .idx knows about (appends are
+    in offset order, so the last entry is the highest)."""
+    from . import idx as idx_mod
+    from .needle import get_actual_size
+
+    sb = _read_super_block(base)
     if not os.path.exists(base + ".idx"):
         return sb.block_size()
     entry_size = 8 + idx_mod.OFFSET_SIZE + 4
@@ -161,17 +166,12 @@ def _indexed_end(base: str) -> int:
 def _index_region(base: str, start: int) -> tuple[int, int]:
     """Append .idx entries for every record at offset ≥ start in the .dat
     (ScanVolumeFileFrom + GenIdx). Returns (writes, deletes)."""
-    import struct
-
     from . import idx as idx_mod
     from .needle import needle_body_length
-    from .super_block import SUPER_BLOCK_SIZE, SuperBlock
 
     writes = deletes = 0
+    sb = _read_super_block(base)
     with open(base + ".dat", "rb") as f, open(base + ".idx", "ab") as out:
-        head = f.read(SUPER_BLOCK_SIZE)
-        extra = struct.unpack(">H", head[6:8])[0]
-        sb = SuperBlock.from_bytes(head + f.read(extra))
         version = sb.version
         fsize = os.path.getsize(base + ".dat")
         offset = max(start, sb.block_size())

@@ -1,0 +1,291 @@
+"""Mount layer: dirty-page intervals, WFS file ops, meta cache, local sync.
+
+Mirrors the coverage the reference gets from filesys/* tests plus manual
+FUSE exercising (dirty_pages_test-style interval cases, fscache tests).
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.mount import WFS, ContinuousIntervals, MetaCache
+from seaweedfs_tpu.mount.sync import MountSync, copy_from_filer, copy_to_filer
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mount")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp / "v")],
+        port=free_port(),
+        master_url=master.url,
+        max_volume_count=20,
+        pulse_seconds=0.5,
+    ).start()
+    time.sleep(0.8)
+    filer = FilerServer(port=free_port(), master_url=master.url).start()
+    yield filer
+    filer.stop()
+    volume.stop()
+    master.stop()
+
+
+# -- dirty page intervals (dirty_page_interval.go tests) ---------------------
+
+
+def test_intervals_basic_merge():
+    ci = ContinuousIntervals()
+    ci.add_interval(0, b"aaaa")
+    ci.add_interval(4, b"bbbb")
+    assert len(ci.intervals) == 1 and ci.intervals[0].data == b"aaaabbbb"
+
+
+def test_intervals_overwrite_wins():
+    ci = ContinuousIntervals()
+    ci.add_interval(0, b"aaaaaaaa")
+    ci.add_interval(2, b"BB")
+    [iv] = ci.intervals
+    assert iv.data == b"aaBBaaaa"
+
+
+def test_intervals_disjoint_and_read():
+    ci = ContinuousIntervals()
+    ci.add_interval(0, b"xx")
+    ci.add_interval(10, b"yy")
+    assert len(ci.intervals) == 2
+    got = ci.read_data_at(0, 12)
+    assert got == [(0, b"xx"), (10, b"yy")]
+    got = ci.read_data_at(1, 2)
+    assert got == [(1, b"x")]
+
+
+def test_intervals_pop_largest():
+    ci = ContinuousIntervals()
+    ci.add_interval(0, b"a" * 100)
+    ci.add_interval(1000, b"b" * 10)
+    assert ci.pop_largest_if_over(200) is None
+    iv = ci.pop_largest_if_over(100)
+    assert iv is not None and iv.start == 0 and len(iv.data) == 100
+    assert ci.total_size() == 10
+
+
+# -- WFS file ops ------------------------------------------------------------
+
+
+def test_wfs_roundtrip_and_listing(stack):
+    wfs = WFS(stack.url, use_meta_cache=False)
+    try:
+        wfs.mkdir("/wfs")
+        wfs.write_file("/wfs/hello.txt", b"hello mount layer")
+        assert wfs.read_file("/wfs/hello.txt") == b"hello mount layer"
+        names = [e.name for e in wfs.listdir("/wfs")]
+        assert "hello.txt" in names
+        st = wfs.stat("/wfs/hello.txt")
+        assert st.file_size() == len(b"hello mount layer")
+    finally:
+        wfs.close()
+
+
+def test_wfs_random_writes_and_read_your_writes(stack):
+    wfs = WFS(stack.url, use_meta_cache=False)
+    try:
+        with wfs.open("/wfs/random.bin", "w") as f:
+            f.write(0, b"0" * 32)
+            f.write(8, b"MIDDLE!!")
+            # dirty (unflushed) reads see the overlay
+            assert f.read(6, 12) == b"00MIDDLE!!00"
+        # after close (flush+commit), committed reads agree
+        assert wfs.read_file("/wfs/random.bin") == b"0" * 8 + b"MIDDLE!!" + b"0" * 16
+    finally:
+        wfs.close()
+
+
+def test_wfs_append_mode(stack):
+    wfs = WFS(stack.url, use_meta_cache=False)
+    try:
+        with wfs.open("/wfs/log.txt", "w") as f:
+            f.write(0, b"line1\n")
+        with wfs.open("/wfs/log.txt", "a") as f:
+            f.write(0, b"line2\n")  # append ignores offset
+        assert wfs.read_file("/wfs/log.txt") == b"line1\nline2\n"
+    finally:
+        wfs.close()
+
+
+def test_wfs_eager_chunking_large_file(stack):
+    wfs = WFS(stack.url, chunk_size=64 * 1024, use_meta_cache=False)
+    try:
+        blob = bytes(range(256)) * 1024  # 256 KB → 4 chunks
+        with wfs.open("/wfs/big.bin", "w") as f:
+            for off in range(0, len(blob), 8192):
+                f.write(off, blob[off : off + 8192])
+        assert wfs.read_file("/wfs/big.bin") == blob
+        st = wfs.stat("/wfs/big.bin")
+        assert len(st.chunks) >= 4
+    finally:
+        wfs.close()
+
+
+def test_wfs_rename_unlink(stack):
+    wfs = WFS(stack.url, use_meta_cache=False)
+    try:
+        wfs.write_file("/wfs/a.txt", b"abc")
+        wfs.rename("/wfs/a.txt", "/wfs/b.txt")
+        assert not wfs.exists("/wfs/a.txt")
+        assert wfs.read_file("/wfs/b.txt") == b"abc"
+        wfs.unlink("/wfs/b.txt")
+        assert not wfs.exists("/wfs/b.txt")
+    finally:
+        wfs.close()
+
+
+def test_wfs_truncate_to_zero(stack):
+    wfs = WFS(stack.url, use_meta_cache=False)
+    try:
+        wfs.write_file("/wfs/trunc.txt", b"old content")
+        with wfs.open("/wfs/trunc.txt", "r+") as f:
+            f.truncate(0)
+            f.write(0, b"new")
+        assert wfs.read_file("/wfs/trunc.txt") == b"new"
+    finally:
+        wfs.close()
+
+
+# -- meta cache --------------------------------------------------------------
+
+
+def test_meta_cache_lazy_fill_and_events(stack):
+    wfs_writer = WFS(stack.url, use_meta_cache=False)
+    cache = MetaCache(stack.url).start(poll_seconds=0.2)
+    try:
+        wfs_writer.write_file("/mc/one.txt", b"1")
+        e = cache.lookup("/mc/one.txt")  # lazy fill on miss
+        assert e is not None and e.file_size() == 1
+        # a new file must arrive via the event feed (no invalidation here)
+        wfs_writer.write_file("/mc/two.txt", b"22")
+        deadline = time.time() + 5
+        got = None
+        while time.time() < deadline:
+            names = [x.name for x in cache.list_dir("/mc")]
+            if "two.txt" in names:
+                got = names
+                break
+            time.sleep(0.1)
+        assert got and "two.txt" in got
+        # deletion propagates too
+        wfs_writer.unlink("/mc/one.txt")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if cache.lookup("/mc/one.txt") is None:
+                break
+            time.sleep(0.1)
+        # lookup falls back to the filer, which 404s → None
+        assert cache.lookup("/mc/one.txt") is None
+    finally:
+        cache.stop()
+        wfs_writer.close()
+
+
+# -- filer.copy + mount sync -------------------------------------------------
+
+
+def test_filer_copy_roundtrip(stack, tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "root.txt").write_bytes(b"root file")
+    (src / "sub" / "nested.bin").write_bytes(bytes(range(256)) * 64)
+    n = copy_to_filer(str(src), stack.url, "/copied")
+    assert n == 2
+    dst = tmp_path / "dst"
+    n = copy_from_filer(stack.url, "/copied", str(dst))
+    assert n == 2
+    assert (dst / "root.txt").read_bytes() == b"root file"
+    assert (dst / "sub" / "nested.bin").read_bytes() == bytes(range(256)) * 64
+
+
+def test_mount_sync_bidirectional(stack, tmp_path):
+    wfs = WFS(stack.url, use_meta_cache=False)
+    wfs.mkdir("/msync")
+    wfs.write_file("/msync/remote_first.txt", b"from remote")
+    local = tmp_path / "mnt"
+    ms = MountSync(stack.url, "/msync", str(local), scan_seconds=0.3).start()
+    try:
+        # initial materialization
+        assert (local / "remote_first.txt").read_bytes() == b"from remote"
+        # local → remote
+        (local / "local_new.txt").write_bytes(b"from local")
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if wfs.exists("/msync/local_new.txt"):
+                break
+            time.sleep(0.2)
+        assert wfs.read_file("/msync/local_new.txt") == b"from local"
+        # remote → local
+        wfs.write_file("/msync/remote_second.txt", b"second remote")
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            p = local / "remote_second.txt"
+            if p.exists() and p.read_bytes() == b"second remote":
+                break
+            time.sleep(0.2)
+        assert (local / "remote_second.txt").read_bytes() == b"second remote"
+        # remote deletion → local deletion
+        wfs.unlink("/msync/remote_first.txt")
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if not (local / "remote_first.txt").exists():
+                break
+            time.sleep(0.2)
+        assert not (local / "remote_first.txt").exists()
+    finally:
+        ms.stop()
+        wfs.close()
+
+
+def test_mount_sync_same_size_update_and_create_delete_race(stack, tmp_path):
+    """Same-byte-count remote updates must still be pulled, and a remote
+    create+delete inside one scan interval must not wedge the feed."""
+    wfs = WFS(stack.url, use_meta_cache=False)
+    wfs.mkdir("/msync2")
+    wfs.write_file("/msync2/flag.txt", b"AAAA")
+    local = tmp_path / "mnt2"
+    ms = MountSync(stack.url, "/msync2", str(local), scan_seconds=0.2).start()
+    try:
+        assert (local / "flag.txt").read_bytes() == b"AAAA"
+        # create+delete race: both events arrive in one poll
+        wfs.write_file("/msync2/ghost.txt", b"gone soon")
+        wfs.unlink("/msync2/ghost.txt")
+        # same-size update
+        wfs.write_file("/msync2/flag.txt", b"BBBB")
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if (local / "flag.txt").read_bytes() == b"BBBB":
+                break
+            time.sleep(0.2)
+        assert (local / "flag.txt").read_bytes() == b"BBBB"
+        # and the loop is still alive: another remote write lands
+        wfs.write_file("/msync2/after.txt", b"still alive")
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            p = local / "after.txt"
+            if p.exists() and p.read_bytes() == b"still alive":
+                break
+            time.sleep(0.2)
+        assert (local / "after.txt").read_bytes() == b"still alive"
+    finally:
+        ms.stop()
+        wfs.close()
