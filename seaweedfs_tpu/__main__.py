@@ -24,11 +24,13 @@ import time
 def cmd_master(args):
     from .server.master_server import MasterServer
 
+    peers = [p.strip() for p in args.peers.split(",") if p.strip()]
     ms = MasterServer(
         host=args.ip,
         port=args.port,
         volume_size_limit_mb=args.volume_size_limit_mb,
         default_replication=args.default_replication,
+        peers=peers or None,
     ).start()
     print(f"master listening on {ms.url}")
     _wait_forever()
@@ -385,6 +387,11 @@ def main(argv=None):
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volumeSizeLimitMB", dest="volume_size_limit_mb", type=int, default=30 * 1024)
     m.add_argument("-defaultReplication", dest="default_replication", default="000")
+    m.add_argument(
+        "-peers",
+        default="",
+        help="comma-separated master peers for HA (weed master -peers)",
+    )
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="run a volume server")

@@ -1,0 +1,165 @@
+"""Master leader election + replicated sequence checkpoint.
+
+Reference: `weed/server/raft_server.go:21-54` — the reference runs a raft
+group among masters whose replicated state machine holds ONLY the sequence
+counter (max file key); topology is rebuilt from volume-server heartbeats,
+and non-leader masters proxy client traffic to the leader
+(`master_server.go` proxyToLeader).
+
+This build keeps those semantics with a lease-based protocol over the
+masters' HTTP plane (no external coordination service, like the reference
+which embeds its consensus):
+
+- every master pings its peers; the smallest-url *alive* master claims
+  leadership and sends `leader_beat`s carrying (term, max_file_key)
+- followers accept beats from a leader with term ≥ their own and
+  checkpoint the sequence high-water mark from each beat, so a failover
+  never re-issues needle ids (the raft-snapshot-of-sequence analog)
+- a follower that misses beats for `lease_seconds` re-evaluates; if it is
+  now the smallest alive url it takes over with term+1
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..server.http_util import http_json
+
+
+class LeaderElection:
+    def __init__(
+        self,
+        self_url: str,
+        peers: list[str],
+        lease_seconds: float = 3.0,
+        get_max_file_key: Optional[Callable[[], int]] = None,
+        on_checkpoint: Optional[Callable[[int], None]] = None,
+        on_leader_change: Optional[Callable[[str], None]] = None,
+    ):
+        self.self_url = self_url
+        # peer set always includes self, deduplicated, stable order
+        self.peers = sorted(set(peers) | {self_url})
+        self.lease_seconds = lease_seconds
+        self.get_max_file_key = get_max_file_key or (lambda: 0)
+        self.on_checkpoint = on_checkpoint or (lambda k: None)
+        self.on_leader_change = on_leader_change or (lambda u: None)
+
+        self.term = 0
+        self.leader: Optional[str] = None
+        # grace: a freshly (re)started master must listen for one full lease
+        # before claiming, or a restarted ex-leader with a cold sequencer
+        # would depose the incumbent and re-issue ids
+        self._last_beat = time.time()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self.leader == self.self_url
+
+    # -- beat intake (follower side) -----------------------------------------
+    def receive_beat(self, leader: str, term: int, max_file_key: int) -> dict:
+        with self._lock:
+            if term < self.term:
+                return {"ok": False, "term": self.term}
+            if (
+                term == self.term
+                and self.leader is not None
+                and leader != self.leader
+                and leader >= self.leader
+            ):
+                # equal-term split claim: smallest url wins deterministically
+                return {"ok": False, "term": self.term}
+            changed = leader != self.leader
+            self.term = term
+            self.leader = leader
+            self._last_beat = time.time()
+        if max_file_key:
+            self.on_checkpoint(max_file_key)
+        if changed:
+            self.on_leader_change(leader)
+        return {"ok": True, "term": term}
+
+    # -- the election loop ---------------------------------------------------
+    def start(self) -> "LeaderElection":
+        if len(self.peers) == 1:
+            # single master: it IS the cluster — lead immediately, no loop
+            # latency (the reference's one-node raft elects itself at boot)
+            self.term = 1
+            self.leader = self.self_url
+            self._last_beat = time.time()
+            self.on_leader_change(self.self_url)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _alive_peers(self) -> list[str]:
+        alive = [self.self_url]
+        for p in self.peers:
+            if p == self.self_url:
+                continue
+            try:
+                r = http_json("GET", f"http://{p}/cluster/ping", timeout=1.0)
+                if r.get("ok"):
+                    alive.append(p)
+            except Exception:
+                continue
+        return sorted(alive)
+
+    def _send_beats(self) -> None:
+        body = {
+            "leader": self.self_url,
+            "term": self.term,
+            "max_file_key": self.get_max_file_key(),
+        }
+        for p in self.peers:
+            if p == self.self_url:
+                continue
+            try:
+                r = http_json(
+                    "POST", f"http://{p}/cluster/leader_beat", body, timeout=1.0
+                )
+                rt = r.get("term", 0)
+                if not r.get("ok") and (
+                    rt > self.term or (rt == self.term and p < self.self_url)
+                ):
+                    # a higher term exists, or an equal-term claimant with a
+                    # smaller url: step down and re-evaluate
+                    with self._lock:
+                        self.term = max(self.term, rt)
+                        self.leader = None
+                    return
+            except Exception:
+                continue
+
+    def _loop(self) -> None:
+        interval = self.lease_seconds / 3.0
+        while not self._stop.wait(interval):
+            if self.is_leader:
+                self._send_beats()
+                with self._lock:
+                    self._last_beat = time.time()
+                continue
+            with self._lock:
+                lease_fresh = (time.time() - self._last_beat) < self.lease_seconds
+            if lease_fresh:
+                continue
+            # lease expired (or never had a leader): claim if smallest alive
+            alive = self._alive_peers()
+            if alive[0] == self.self_url:
+                with self._lock:
+                    self.term += 1
+                    changed = self.leader != self.self_url
+                    self.leader = self.self_url
+                    self._last_beat = time.time()
+                if changed:
+                    self.on_leader_change(self.self_url)
+                self._send_beats()

@@ -114,9 +114,18 @@ class MetaCache:
             row = self._db.execute(
                 "SELECT entry FROM entries WHERE path = ?", (path,)
             ).fetchone()
+            parent_visited = bool(
+                self._db.execute(
+                    "SELECT 1 FROM visited WHERE dir = ?", (_parent(path),)
+                ).fetchone()
+            )
         if row:
             return Entry.from_dict(json.loads(row[0]))
-        # fall back to the filer (root, or un-listed parents)
+        if parent_visited:
+            # the cached listing is authoritative: a miss is a real miss —
+            # no per-negative-lookup filer round-trip
+            return None
+        # fall back to the filer (root, or parents whose listing failed)
         d = self.client.get_entry(path)
         if d is None:
             return None
@@ -142,5 +151,9 @@ class MetaCache:
                 "DELETE FROM entries WHERE path = ? OR path LIKE ?",
                 (path, path + "/%"),
             )
-            self._db.execute("DELETE FROM visited WHERE dir = ?", (path,))
+            # drop the listing markers too: the parent's cached listing no
+            # longer authoritatively covers this path
+            self._db.execute(
+                "DELETE FROM visited WHERE dir IN (?, ?)", (path, _parent(path))
+            )
             self._db.commit()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
+import urllib.parse
 from typing import Optional
 
 from ..cluster.master import Master
@@ -34,6 +35,8 @@ class MasterServer:
         node_timeout: float = 15.0,
         jwt_signing_key: str = "",
         jwt_expires_seconds: int = 10,
+        peers: Optional[list[str]] = None,
+        lease_seconds: float = 3.0,
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
@@ -50,6 +53,22 @@ class MasterServer:
         self._srv = None
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # HA (raft_server.go analog): single master ⇒ immediate self-leader
+        from ..cluster.election import LeaderElection
+
+        # Beats checkpoint the sequence AHEAD of use (peek + margin), like
+        # the reference's batch-allocating sequencer riding raft snapshots:
+        # ids handed out between two beats can never collide after a
+        # failover — the new leader starts past the margin (gaps in needle
+        # ids are harmless).
+        seq_margin = 1_000_000
+        self.election = LeaderElection(
+            f"{host}:{port}",
+            peers or [f"{host}:{port}"],
+            lease_seconds=lease_seconds,
+            get_max_file_key=lambda: self.master.sequencer.peek() + seq_margin,
+            on_checkpoint=self.master.sequencer.set_max,
+        )
 
     # -- volume allocation via volume server admin endpoint ------------------
     def _allocate_volume(self, dn: DataNode, vid: int, option) -> None:
@@ -61,6 +80,34 @@ class MasterServer:
         )
         if r.get("error"):
             raise RuntimeError(f"allocate volume {vid} on {dn.url()}: {r['error']}")
+
+    # -- leader proxying (master_server.go proxyToLeader) --------------------
+    def _proxy_to_leader(self, h, path, q, body):
+        leader = self.election.leader
+        if leader is None:
+            return 503, {"error": "no leader elected yet"}
+        # one hop max (the reference's proxyToLeader refuses to re-proxy):
+        # during a leadership flap two masters may briefly each point at the
+        # other; without the guard a request bounces until threads exhaust
+        q = dict(q)
+        q["proxied"] = "1"
+        qs = urllib.parse.urlencode(q)
+        url = f"http://{leader}{path}" + (f"?{qs}" if qs else "")
+        try:
+            r = http_json(h.command, url, body=body or None)
+        except Exception as e:
+            return 502, {"error": f"proxy to leader {leader}: {e}"}
+        return r.pop("_status", 200), r
+
+    def _leader_only(self, handler):
+        def wrapped(h, path, q, body):
+            if not self.election.is_leader:
+                if q.get("proxied"):
+                    return 503, {"error": "leadership unsettled (proxy loop)"}
+                return self._proxy_to_leader(h, path, q, body)
+            return handler(h, path, q, body)
+
+        return wrapped
 
     # -- handlers ------------------------------------------------------------
     def _h_assign(self, h, path, q, body):
@@ -124,6 +171,9 @@ class MasterServer:
                 )
                 self._nodes[url] = dn
             ack = self.master.handle_heartbeat(dn, hb)
+        # announce the leader so volume servers re-point after failover
+        # (volume_grpc_client_to_master.go:155-197 recv loop)
+        ack["leader"] = self.election.leader
         return 200, ack
 
     def _h_grow(self, h, path, q, body):
@@ -171,8 +221,22 @@ class MasterServer:
     def _h_status(self, h, path, q, body):
         return 200, {
             "version": "seaweedfs_tpu 0.1",
+            "leader": self.election.leader,
+            "is_leader": self.election.is_leader,
+            "term": self.election.term,
             "topology": self.master.topology_info(),
         }
+
+    def _h_ping(self, h, path, q, body):
+        return 200, {"ok": True, "url": self.url}
+
+    def _h_leader_beat(self, h, path, q, body):
+        import json
+
+        b = json.loads(body)
+        return 200, self.election.receive_beat(
+            b["leader"], b["term"], b.get("max_file_key", 0)
+        )
 
     def _h_lock(self, h, path, q, body):
         try:
@@ -214,20 +278,26 @@ class MasterServer:
 
         class Handler(JsonHandler):
             routes = [
-                ("GET", "/dir/assign", ms._h_assign),
-                ("POST", "/dir/assign", ms._h_assign),
-                ("GET", "/dir/lookup_ec", ms._h_lookup_ec),
-                ("GET", "/dir/lookup", ms._h_lookup),
+                # leader-only (writes/config): followers proxy to the leader
+                ("GET", "/dir/assign", ms._leader_only(ms._h_assign)),
+                ("POST", "/dir/assign", ms._leader_only(ms._h_assign)),
+                ("POST", "/vol/grow", ms._leader_only(ms._h_grow)),
+                ("GET", "/vol/grow", ms._leader_only(ms._h_grow)),
+                ("POST", "/vol/vacuum", ms._leader_only(ms._h_vacuum)),
+                ("GET", "/vol/vacuum", ms._leader_only(ms._h_vacuum)),
+                ("POST", "/col/delete", ms._leader_only(ms._h_col_delete)),
+                ("POST", "/cluster/lock", ms._leader_only(ms._h_lock)),
+                ("POST", "/cluster/unlock", ms._leader_only(ms._h_unlock)),
+                # reads proxy too: only the leader's topology is fed by
+                # heartbeats, so followers answer through it (the reference
+                # wraps these handlers in proxyToLeader as well)
+                ("GET", "/dir/lookup_ec", ms._leader_only(ms._h_lookup_ec)),
+                ("GET", "/dir/lookup", ms._leader_only(ms._h_lookup)),
+                ("GET", "/col/list", ms._leader_only(ms._h_collections)),
+                ("GET", "/cluster/watch", ms._leader_only(ms._h_watch)),
                 ("POST", "/cluster/heartbeat", ms._h_heartbeat),
-                ("POST", "/vol/grow", ms._h_grow),
-                ("GET", "/vol/grow", ms._h_grow),
-                ("POST", "/vol/vacuum", ms._h_vacuum),
-                ("GET", "/vol/vacuum", ms._h_vacuum),
-                ("POST", "/col/delete", ms._h_col_delete),
-                ("GET", "/col/list", ms._h_collections),
-                ("POST", "/cluster/lock", ms._h_lock),
-                ("POST", "/cluster/unlock", ms._h_unlock),
-                ("GET", "/cluster/watch", ms._h_watch),
+                ("GET", "/cluster/ping", ms._h_ping),
+                ("POST", "/cluster/leader_beat", ms._h_leader_beat),
                 ("GET", "/dir/status", ms._h_status),
                 ("GET", "/cluster/status", ms._h_status),
             ]
@@ -235,10 +305,12 @@ class MasterServer:
         self._srv = start_server(Handler, self.host, self.port)
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+        self.election.start()
         return self
 
     def stop(self):
         self._stop.set()
+        self.election.stop()
         if self._srv:
             self._srv.shutdown()
             self._srv.server_close()

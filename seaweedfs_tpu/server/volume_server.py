@@ -74,7 +74,10 @@ class VolumeServer:
         self.jwt_read_key = jwt_read_key
         self.guard = Guard(whitelist)
         self.host, self.port = host, port
-        self.master_url = master_url
+        # comma-separated seed list (weed volume -mserver=a,b,c); the live
+        # target follows the announced leader
+        self.master_seeds = [m.strip() for m in master_url.split(",") if m.strip()]
+        self.master_url = self.master_seeds[0]
         self.data_center, self.rack = data_center, rack
         self.max_volume_count = max_volume_count
         self.pulse_seconds = pulse_seconds
@@ -562,16 +565,31 @@ class VolumeServer:
         hb["data_center"] = self.data_center
         hb["rack"] = self.rack
         hb["max_volume_count"] = self.max_volume_count
-        http_json(
+        ack = http_json(
             "POST", f"http://{self.master_url}/cluster/heartbeat", hb, timeout=10
         )
+        # follow the announced leader (the reference reconnects its stream
+        # to the new leader on the master's say-so)
+        leader = ack.get("leader")
+        if leader and leader != self.master_url:
+            self.master_url = leader
 
     def _hb_loop(self):
         while not self._stop.wait(self.pulse_seconds):
             try:
                 self._heartbeat_once()
             except Exception:
-                pass  # master down: keep trying (failover comes with HA)
+                # current master unreachable: try the next seed
+                self._rotate_master()
+
+    def _rotate_master(self) -> None:
+        if len(self.master_seeds) <= 1:
+            return
+        try:
+            i = self.master_seeds.index(self.master_url)
+        except ValueError:
+            i = -1
+        self.master_url = self.master_seeds[(i + 1) % len(self.master_seeds)]
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):

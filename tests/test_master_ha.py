@@ -1,0 +1,140 @@
+"""Master HA: leader election, follower proxying, sequence checkpoint,
+volume-server failover (raft_server.go + proxyToLeader analogs)."""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.http_util import http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_for(cond, timeout=10.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    return None
+
+
+@pytest.fixture()
+def trio(tmp_path):
+    ports = sorted(free_port() for _ in range(3))
+    urls = [f"127.0.0.1:{p}" for p in ports]
+    masters = [
+        MasterServer(
+            port=p, peers=urls, lease_seconds=1.2, node_timeout=60
+        ).start()
+        for p in ports
+    ]
+    # volume server seeded with all three masters
+    vs = VolumeServer(
+        [str(tmp_path / "v")],
+        port=free_port(),
+        master_url=",".join(urls),
+        max_volume_count=10,
+        pulse_seconds=0.3,
+    ).start()
+    yield urls, masters, vs
+    vs.stop()
+    for m in masters:
+        try:
+            m.stop()
+        except Exception:
+            pass
+
+
+def leader_of(url):
+    try:
+        return http_json("GET", f"http://{url}/cluster/status", timeout=2.0).get(
+            "leader"
+        )
+    except Exception:
+        return None
+
+
+def test_election_converges_and_proxies(trio):
+    urls, masters, vs = trio
+    # all three agree on one leader (smallest alive url)
+    lead = wait_for(
+        lambda: (
+            leader_of(urls[0])
+            if leader_of(urls[0]) == leader_of(urls[1]) == leader_of(urls[2])
+            and leader_of(urls[0]) is not None
+            else None
+        )
+    )
+    assert lead == urls[0]
+    # wait until the leader knows the volume server
+    assert wait_for(
+        lambda: http_json("GET", f"http://{lead}/dir/status")["topology"][
+            "data_centers"
+        ]
+    )
+    # an assign sent to a FOLLOWER must be proxied to the leader and work
+    a = operation.assign(urls[2])
+    assert a.fid and a.url
+    operation.upload_data(a.url, a.fid, b"via follower proxy")
+    assert operation.download(urls[1], a.fid) == b"via follower proxy"
+
+
+def test_failover_elects_next_and_keeps_sequence(trio):
+    urls, masters, vs = trio
+    lead = wait_for(lambda: leader_of(urls[1]))
+    assert lead == urls[0]
+    # allocate some ids on the original leader
+    a1 = operation.assign(urls[0])
+    key1 = int(a1.fid.split(",")[1][:-8], 16)
+    # leader beats carry the sequence high-water mark; wait for a follower
+    # to checkpoint it (raft snapshot analog), then kill the leader
+    assert wait_for(lambda: masters[1].master.sequencer.peek() > key1)
+    masters[0].stop()
+    # a new leader (next smallest) takes over
+    new_lead = wait_for(
+        lambda: (
+            leader_of(urls[1])
+            if leader_of(urls[1]) == leader_of(urls[2])
+            and leader_of(urls[1]) in (urls[1], urls[2])
+            else None
+        ),
+        timeout=15,
+    )
+    assert new_lead == urls[1]
+    # volume server re-points its heartbeats to the new leader
+    assert wait_for(
+        lambda: http_json("GET", f"http://{new_lead}/dir/status")["topology"][
+            "data_centers"
+        ],
+        timeout=15,
+    )
+    assert wait_for(lambda: vs.master_url == new_lead, timeout=15)
+    # sequence must not restart: new ids stay above the checkpointed max
+    a2 = operation.assign(new_lead)
+    key2 = int(a2.fid.split(",")[1][:-8], 16)
+    assert key2 > key1
+    # and the cluster still serves writes end-to-end
+    operation.upload_data(a2.url, a2.fid, b"after failover")
+    assert operation.download(new_lead, a2.fid) == b"after failover"
+
+
+def test_single_master_is_its_own_leader(tmp_path):
+    m = MasterServer(port=free_port(), node_timeout=60).start()
+    try:
+        assert wait_for(lambda: leader_of(m.url) == m.url)
+        st = http_json("GET", f"http://{m.url}/cluster/status")
+        assert st["is_leader"] is True
+    finally:
+        m.stop()
