@@ -83,6 +83,7 @@ def cmd_filer(args):
         db_path=args.db,
         collection=args.collection,
         replication=args.replication,
+        cipher=args.encrypt_volume_data,
     ).start()
     print(f"filer on {fs.url} → master {args.master}")
     _wait_forever()
@@ -422,6 +423,12 @@ def main(argv=None):
     f.add_argument("-db", default=":memory:")
     f.add_argument("-collection", default="")
     f.add_argument("-replication", default="")
+    f.add_argument(
+        "-encryptVolumeData",
+        dest="encrypt_volume_data",
+        action="store_true",
+        help="AES-256-GCM encrypt chunk data (weed filer -encryptVolumeData)",
+    )
     f.set_defaults(fn=cmd_filer)
 
     u = sub.add_parser("upload", help="upload files")

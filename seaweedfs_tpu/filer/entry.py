@@ -19,15 +19,19 @@ class FileChunk:
     size: int
     mtime: int = 0  # ns; decides overlap winners
     etag: str = ""
+    cipher_key: str = ""  # base64 AES-256-GCM key (filer.proto cipher_key)
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "file_id": self.file_id,
             "offset": self.offset,
             "size": self.size,
             "mtime": self.mtime,
             "etag": self.etag,
         }
+        if self.cipher_key:
+            d["cipher_key"] = self.cipher_key
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "FileChunk":
@@ -37,6 +41,7 @@ class FileChunk:
             size=d.get("size", 0),
             mtime=d.get("mtime", 0),
             etag=d.get("etag", ""),
+            cipher_key=d.get("cipher_key", ""),
         )
 
 

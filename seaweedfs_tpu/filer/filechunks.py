@@ -24,6 +24,7 @@ class VisibleInterval:
     mtime: int
     chunk_offset: int  # offset within the stored chunk where this slice begins
     chunk_size: int
+    cipher_key: str = ""  # base64 AES-256 key for encrypted chunks
 
 
 @dataclass(frozen=True)
@@ -33,6 +34,7 @@ class ChunkView:
     size: int
     logic_offset: int  # offset within the logical file
     chunk_size: int
+    cipher_key: str = ""  # base64 AES-256 key for encrypted chunks
 
     @property
     def is_full_chunk(self) -> bool:
@@ -44,7 +46,13 @@ def merge_into_visibles(
 ) -> list[VisibleInterval]:
     """Apply one (newer) chunk over the visible set (MergeIntoVisibles)."""
     new_v = VisibleInterval(
-        chunk.offset, chunk.offset + chunk.size, chunk.file_id, chunk.mtime, 0, chunk.size
+        chunk.offset,
+        chunk.offset + chunk.size,
+        chunk.file_id,
+        chunk.mtime,
+        0,
+        chunk.size,
+        chunk.cipher_key,
     )
     if not visibles or visibles[-1].stop <= chunk.offset:
         return visibles + [new_v]
@@ -54,7 +62,13 @@ def merge_into_visibles(
         if v.start < chunk.offset < v.stop:
             out.append(
                 VisibleInterval(
-                    v.start, chunk.offset, v.file_id, v.mtime, v.chunk_offset, v.chunk_size
+                    v.start,
+                    chunk.offset,
+                    v.file_id,
+                    v.mtime,
+                    v.chunk_offset,
+                    v.chunk_size,
+                    v.cipher_key,
                 )
             )
         if v.start < chunk_stop < v.stop:
@@ -66,6 +80,7 @@ def merge_into_visibles(
                     v.mtime,
                     v.chunk_offset + (chunk_stop - v.start),
                     v.chunk_size,
+                    v.cipher_key,
                 )
             )
         if chunk_stop <= v.start or v.stop <= chunk.offset:
@@ -103,6 +118,7 @@ def view_from_visibles(
                     size=end - start,
                     logic_offset=start,
                     chunk_size=v.chunk_size,
+                    cipher_key=v.cipher_key,
                 )
             )
     return views

@@ -24,6 +24,7 @@ Deleted/overwritten chunk fids are purged from the object store
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import json
 import random
@@ -70,6 +71,7 @@ class FilerServer:
         jwt_signing_key: str = "",
         chunk_cache_dir: str = "",
         chunk_cache_mem_mb: int = 64,
+        cipher: bool = False,
     ):
         from ..stats import default_registry
         from ..util.chunk_cache import TieredChunkCache
@@ -88,6 +90,7 @@ class FilerServer:
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
+        self.cipher = cipher
         self.filer = Filer(
             store=SqliteStore(db_path), chunk_purger=self._purge_chunks
         )
@@ -230,6 +233,7 @@ class FilerServer:
         collection = q.get("collection", self.collection)
         replication = q.get("replication", self.replication)
         ttl = q.get("ttl", "")
+        use_cipher = self.cipher or q.get("cipher") == "true"
         chunks = []
         offset = 0
         mv = memoryview(body)
@@ -241,14 +245,25 @@ class FilerServer:
                 replication=replication,
                 ttl=ttl,
             )
-            r = operation.upload_data(a.url, a.fid, piece, ttl=ttl, jwt=a.auth)
+            cipher_key_b64 = ""
+            payload = piece
+            if use_cipher:
+                # fresh key per chunk; the store holds only ciphertext and
+                # the filer entry holds the key (_write_cipher.go)
+                from ..util import cipher as cipher_mod
+
+                key = cipher_mod.gen_cipher_key()
+                payload = cipher_mod.encrypt(piece, key)
+                cipher_key_b64 = base64.b64encode(key).decode()
+            r = operation.upload_data(a.url, a.fid, payload, ttl=ttl, jwt=a.auth)
             chunks.append(
                 FileChunk(
                     file_id=a.fid,
                     offset=offset,
-                    size=len(piece),
+                    size=len(piece),  # logical (plaintext) size
                     mtime=time.time_ns(),
                     etag=r.get("eTag", ""),
+                    cipher_key=cipher_key_b64,
                 )
             )
             offset += len(piece)
@@ -361,22 +376,33 @@ class FilerServer:
 
         views = view_from_chunks(entry.chunks, offset, size)
         out = bytearray(size)
+        decrypted: dict[str, bytes] = {}  # per-call memo; cache stays ciphertext
         for view in views:
-            data = self.chunk_cache.get(view.file_id)
+            data = decrypted.get(view.file_id)
             if data is None:
-                fid = FileId.parse(view.file_id)
-                locs = self._lookup.lookup(fid.volume_id)
-                for loc in locs:
-                    status, body = http_bytes(
-                        "GET", f"http://{loc['url']}/{view.file_id}"
-                    )
-                    if status == 200:
-                        data = body
-                        break
+                data = self.chunk_cache.get(view.file_id)
                 if data is None:
-                    self._lookup.invalidate(fid.volume_id)
-                    data = operation.download(self.master_url, view.file_id)
-                self.chunk_cache.put(view.file_id, data)
+                    fid = FileId.parse(view.file_id)
+                    locs = self._lookup.lookup(fid.volume_id)
+                    for loc in locs:
+                        status, body = http_bytes(
+                            "GET", f"http://{loc['url']}/{view.file_id}"
+                        )
+                        if status == 200:
+                            data = body
+                            break
+                    if data is None:
+                        self._lookup.invalidate(fid.volume_id)
+                        data = operation.download(self.master_url, view.file_id)
+                    # the cache (incl. its on-disk tiers) holds ciphertext only
+                    self.chunk_cache.put(view.file_id, data)
+                if view.cipher_key:
+                    from ..util import cipher as cipher_mod
+
+                    data = cipher_mod.decrypt(
+                        data, base64.b64decode(view.cipher_key)
+                    )
+                    decrypted[view.file_id] = data
             piece = data[view.offset : view.offset + view.size]
             pos = view.logic_offset - offset
             out[pos : pos + len(piece)] = piece
