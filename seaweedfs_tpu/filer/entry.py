@@ -20,6 +20,7 @@ class FileChunk:
     mtime: int = 0  # ns; decides overlap winners
     etag: str = ""
     cipher_key: str = ""  # base64 AES-256-GCM key (filer.proto cipher_key)
+    is_chunk_manifest: bool = False  # chunk-of-chunks marker (filer.proto)
 
     def to_dict(self) -> dict:
         d = {
@@ -31,6 +32,8 @@ class FileChunk:
         }
         if self.cipher_key:
             d["cipher_key"] = self.cipher_key
+        if self.is_chunk_manifest:
+            d["is_chunk_manifest"] = True
         return d
 
     @classmethod
@@ -42,6 +45,7 @@ class FileChunk:
             mtime=d.get("mtime", 0),
             etag=d.get("etag", ""),
             cipher_key=d.get("cipher_key", ""),
+            is_chunk_manifest=d.get("is_chunk_manifest", False),
         )
 
 

@@ -29,8 +29,27 @@ class Filer:
         self.store = store or MemoryStore()
         self.meta_log = MetaLog()
         self.chunk_purger = chunk_purger
+        # expands manifest chunks into their children before purging so
+        # chunk-of-chunks files don't leak data chunks on delete/overwrite
+        # (filer_delete_entry.go ResolveChunkManifest); the server wires a
+        # resolver that can actually read manifest blobs
+        self.chunk_resolver: Optional[Callable[[list], list]] = None
         self._lock = threading.RLock()
         self._ensure_root()
+
+    def _fids(self, chunks) -> list[str]:
+        if self.chunk_resolver is not None:
+            try:
+                resolved = self.chunk_resolver(chunks)
+                # manifest fids themselves are garbage too
+                return [c.file_id for c in chunks] + [
+                    c.file_id
+                    for c in resolved
+                    if c.file_id not in {x.file_id for x in chunks}
+                ]
+            except Exception:
+                pass  # fall back: purge at least the listed fids
+        return [c.file_id for c in chunks]
 
     def _ensure_root(self) -> None:
         try:
@@ -59,6 +78,23 @@ class Filer:
                     raise FileExistsError(entry.full_path)
                 if old.is_directory and not entry.is_directory:
                     raise IsADirectoryError(entry.full_path)
+            if old is not None and old.hard_link_id and not entry.hard_link_id:
+                # writing through a linked path updates the shared inode so
+                # every link sees the new content (filerstore_hardlink.go)
+                inode = self._resolve_hardlink(old)
+                counter = inode.hard_link_counter
+                entry.hard_link_id = old.hard_link_id
+                self._write_hardlink_content(old.hard_link_id, entry, counter)
+                old = inode  # garbage math uses the inode's real chunks
+                self.meta_log.append(
+                    entry.parent, old.to_dict(), entry.to_dict(),
+                    signatures=signatures,
+                )
+                if old.chunks and self.chunk_purger:
+                    garbage = minus_chunks(old.chunks, entry.chunks)
+                    if garbage:
+                        self.chunk_purger(self._fids(garbage))
+                return entry
             self.store.insert_entry(entry)
         self.meta_log.append(
             entry.parent,
@@ -70,7 +106,7 @@ class Filer:
         if old is not None and old.chunks and self.chunk_purger:
             garbage = minus_chunks(old.chunks, entry.chunks)
             if garbage:
-                self.chunk_purger([c.file_id for c in garbage])
+                self.chunk_purger(self._fids(garbage))
         return entry
 
     def _ensure_parents(self, dir_path: str) -> None:
@@ -89,8 +125,69 @@ class Filer:
         self.store.insert_entry(d)
         self.meta_log.append(parent, None, d.to_dict())
 
+    # -- hardlinks (filer/filerstore_hardlink.go) ----------------------------
+    # Linked paths are stubs carrying a hard_link_id; the shared "inode"
+    # (attrs + chunk list + link counter) lives in the store's KV under
+    # hardlink/<id>, so a write through any path is seen by all of them.
+    _HARDLINK_KV = b"hardlink/"
+
+    def _hardlink_key(self, hid: str) -> bytes:
+        return self._HARDLINK_KV + hid.encode()
+
+    def _resolve_hardlink(self, entry: Entry) -> Entry:
+        if not entry.hard_link_id:
+            return entry
+        import json as _json
+
+        raw = self.store.kv_get(self._hardlink_key(entry.hard_link_id))
+        if not raw:
+            return entry  # dangling stub: serve as-is
+        content = _json.loads(raw)
+        resolved = Entry.from_dict(content | {"full_path": entry.full_path})
+        resolved.hard_link_id = entry.hard_link_id
+        resolved.hard_link_counter = content.get("hard_link_counter", 1)
+        return resolved
+
+    def _write_hardlink_content(self, hid: str, entry: Entry, counter: int) -> None:
+        import json as _json
+
+        content = entry.to_dict()
+        content["hard_link_counter"] = counter
+        self.store.kv_put(self._hardlink_key(hid), _json.dumps(content).encode())
+
+    def link(self, target_path: str, link_path: str) -> Entry:
+        """Create a hardlink at link_path referencing target_path's inode
+        (filer_grpc_server link handling for mount's Link op)."""
+        import secrets as _secrets
+
+        with self._lock:
+            raw = self.store.find_entry(target_path)
+            if raw.is_directory:
+                raise IsADirectoryError(target_path)
+            if raw.hard_link_id:
+                hid = raw.hard_link_id
+                inode = self._resolve_hardlink(raw)
+                counter = inode.hard_link_counter + 1
+            else:
+                hid = _secrets.token_hex(8)
+                inode = raw
+                counter = 2
+                stub = Entry(full_path=target_path)
+                stub.hard_link_id = hid
+                stub.mode = raw.mode
+                self.store.update_entry(stub)
+            self._write_hardlink_content(hid, inode, counter)
+            link_stub = Entry(full_path=link_path)
+            link_stub.hard_link_id = hid
+            link_stub.mode = inode.mode
+            self._ensure_parents(link_stub.parent)
+            self.store.insert_entry(link_stub)
+        resolved = self._resolve_hardlink(link_stub)
+        self.meta_log.append(link_stub.parent, None, resolved.to_dict())
+        return resolved
+
     def find_entry(self, path: str) -> Entry:
-        return self.store.find_entry(path)
+        return self._resolve_hardlink(self.store.find_entry(path))
 
     def update_entry(self, entry: Entry) -> Entry:
         with self._lock:
@@ -143,6 +240,28 @@ class Filer:
     ) -> list[str]:
         entry = self.store.find_entry(path)
         fids: list[str] = []
+        if entry.hard_link_id:
+            # unlink: drop the stub, decrement the inode's counter; chunks
+            # are purged only when the last link goes away
+            import json as _json
+
+            hid = entry.hard_link_id
+            inode = self._resolve_hardlink(entry)
+            counter = inode.hard_link_counter - 1
+            self.store.delete_entry(path)
+            if counter <= 0:
+                self.store.kv_put(self._hardlink_key(hid), b"")
+                fids = self._fids(inode.chunks)
+            else:
+                self._write_hardlink_content(hid, inode, counter)
+            self.meta_log.append(
+                entry.parent,
+                inode.to_dict() | {"full_path": path},
+                None,
+                delete_chunks=bool(fids),
+                signatures=signatures,
+            )
+            return fids
         with self._lock:
             if entry.is_directory:
                 children = list(self.store.list_entries(path, limit=1_000_000))
@@ -158,7 +277,7 @@ class Filer:
                     except Exception:
                         if not ignore_recursive_error:
                             raise
-            fids.extend(c.file_id for c in entry.chunks)
+            fids.extend(self._fids(entry.chunks))
             self.store.delete_entry(path)
         self.meta_log.append(
             entry.parent,
@@ -172,7 +291,8 @@ class Filer:
     def list_entries(
         self, dir_path: str, start_after: str = "", limit: int = 1000
     ) -> Iterator[Entry]:
-        return self.store.list_entries(dir_path, start_after, limit)
+        for e in self.store.list_entries(dir_path, start_after, limit):
+            yield self._resolve_hardlink(e)
 
     # -- maintenance ---------------------------------------------------------
     def compact_chunks(self, path: str) -> int:
@@ -184,7 +304,7 @@ class Filer:
             entry.chunks = compacted
             self.store.update_entry(entry)
             if self.chunk_purger:
-                self.chunk_purger([c.file_id for c in garbage])
+                self.chunk_purger(self._fids(garbage))
         return len(garbage)
 
     def rename(self, old_path: str, new_path: str) -> Entry:
@@ -200,9 +320,7 @@ class Filer:
             displaced: list[str] = []
             try:
                 dest = self.store.find_entry(new_path)
-                displaced = [
-                    c.file_id for c in minus_chunks(dest.chunks, entry.chunks)
-                ]
+                displaced = self._fids(minus_chunks(dest.chunks, entry.chunks))
             except NotFoundError:
                 pass
             new_entry = Entry.from_dict(entry.to_dict())

@@ -72,6 +72,7 @@ class FilerServer:
         chunk_cache_dir: str = "",
         chunk_cache_mem_mb: int = 64,
         cipher: bool = False,
+        manifest_batch: int = 1000,
     ):
         from ..stats import default_registry
         from ..util.chunk_cache import TieredChunkCache
@@ -91,9 +92,15 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         self.cipher = cipher
+        self.manifest_batch = manifest_batch
         self.filer = Filer(
             store=SqliteStore(db_path), chunk_purger=self._purge_chunks
         )
+        self.filer.chunk_resolver = self._resolve_chunks
+        from ..filer.filer_conf import FILER_CONF_PATH, FilerConf
+
+        self._conf_path = FILER_CONF_PATH
+        self.filer_conf = FilerConf()
         # wdclient keeps the vid map warm off the master's KeepConnected
         # feed (wdclient/masterclient.go); hot-path reads never block on a
         # master round-trip unless the vid is genuinely unknown
@@ -215,6 +222,10 @@ class FilerServer:
         if q.get("mv.to"):
             entry = self.filer.rename(path.rstrip("/") or "/", q["mv.to"])
             return 200, {"name": entry.name, "path": entry.full_path}
+        if q.get("link.to"):
+            # hardlink: this path becomes another name for link.to's inode
+            entry = self.filer.link(q["link.to"], path.rstrip("/"))
+            return 201, {"name": entry.name, "hard_link_id": entry.hard_link_id}
         if q.get("meta") == "true":
             d = json.loads(body)
             d["full_path"] = path.rstrip("/") or "/"
@@ -230,9 +241,12 @@ class FilerServer:
                 self.filer.create_entry(entry)
                 return 201, {"name": entry.name}
             return 400, {"error": "cannot write to a directory path"}
-        collection = q.get("collection", self.collection)
-        replication = q.get("replication", self.replication)
-        ttl = q.get("ttl", "")
+        # path-prefix storage rules (filer_conf.go): explicit query params
+        # win, then the longest-prefix rule, then server defaults
+        rule = self.filer_conf.match_storage_rule(path)
+        collection = q.get("collection") or rule.collection or self.collection
+        replication = q.get("replication") or rule.replication or self.replication
+        ttl = q.get("ttl") or rule.ttl or ""
         use_cipher = self.cipher or q.get("cipher") == "true"
         chunks = []
         offset = 0
@@ -267,6 +281,18 @@ class FilerServer:
                 )
             )
             offset += len(piece)
+        if len(chunks) >= self.manifest_batch:
+            # chunk-of-chunks packing keeps entry metadata bounded for
+            # TB-scale files (filechunk_manifest.go MaybeManifestize)
+            from ..filer.filechunk_manifest import maybe_manifestize
+
+            chunks = maybe_manifestize(
+                lambda blob: self._save_blob_as_chunk(
+                    blob, collection, replication, ttl, use_cipher
+                ),
+                chunks,
+                self.manifest_batch,
+            )
         # header names arrive case-mangled (urllib capitalizes); Title-Case
         # them so readers can filter with a canonical prefix
         extended = {
@@ -365,37 +391,89 @@ class FilerServer:
             return 206, data
         return 200, data
 
+    def _save_blob_as_chunk(
+        self,
+        blob: bytes,
+        collection: str,
+        replication: str,
+        ttl: str,
+        use_cipher: bool,
+    ) -> FileChunk:
+        """Assign + upload one blob; used for manifest chunks."""
+        a = operation.assign(
+            self.master_url, collection=collection, replication=replication, ttl=ttl
+        )
+        cipher_key_b64 = ""
+        payload = blob
+        if use_cipher:
+            from ..util import cipher as cipher_mod
+
+            key = cipher_mod.gen_cipher_key()
+            payload = cipher_mod.encrypt(blob, key)
+            cipher_key_b64 = base64.b64encode(key).decode()
+        operation.upload_data(a.url, a.fid, payload, ttl=ttl, jwt=a.auth)
+        return FileChunk(
+            file_id=a.fid,
+            offset=0,
+            size=len(blob),
+            mtime=time.time_ns(),
+            cipher_key=cipher_key_b64,
+        )
+
+    def _fetch_chunk(self, file_id: str) -> bytes:
+        """One stored chunk's raw (possibly encrypted) bytes, cache-aside."""
+        from ..storage.file_id import FileId
+        from .http_util import http_bytes
+
+        data = self.chunk_cache.get(file_id)
+        if data is not None:
+            return data
+        fid = FileId.parse(file_id)
+        locs = self._lookup.lookup(fid.volume_id)
+        for loc in locs:
+            status, body = http_bytes("GET", f"http://{loc['url']}/{file_id}")
+            if status == 200:
+                data = body
+                break
+        if data is None:
+            self._lookup.invalidate(fid.volume_id)
+            data = operation.download(self.master_url, file_id)
+        # the cache (incl. its on-disk tiers) holds ciphertext only
+        self.chunk_cache.put(file_id, data)
+        return data
+
+    def _read_chunk_plain(self, file_id: str, cipher_key: str) -> bytes:
+        data = self._fetch_chunk(file_id)
+        if cipher_key:
+            from ..util import cipher as cipher_mod
+
+            data = cipher_mod.decrypt(data, base64.b64decode(cipher_key))
+        return data
+
+    def _resolve_chunks(self, chunks) -> list[FileChunk]:
+        """Expand chunk manifests (filechunk_manifest.go ResolveChunkManifest)."""
+        from ..filer.filechunk_manifest import (
+            has_chunk_manifest,
+            resolve_chunk_manifest,
+        )
+
+        if not has_chunk_manifest(chunks):
+            return list(chunks)
+        return resolve_chunk_manifest(self._read_chunk_plain, chunks)
+
     def _read_range(self, entry: Entry, offset: int, size: int) -> bytes:
         """StreamContent (filer/stream.go:16): chunk views → volume reads.
 
         Whole chunks are fetched and sliced (the reference issues ranged
         chunk GETs — a volume-server Range feature to add); volume lookups
         are cached to keep master round-trips off the read path."""
-        from ..storage.file_id import FileId
-        from .http_util import http_bytes
-
-        views = view_from_chunks(entry.chunks, offset, size)
+        views = view_from_chunks(self._resolve_chunks(entry.chunks), offset, size)
         out = bytearray(size)
         decrypted: dict[str, bytes] = {}  # per-call memo; cache stays ciphertext
         for view in views:
             data = decrypted.get(view.file_id)
             if data is None:
-                data = self.chunk_cache.get(view.file_id)
-                if data is None:
-                    fid = FileId.parse(view.file_id)
-                    locs = self._lookup.lookup(fid.volume_id)
-                    for loc in locs:
-                        status, body = http_bytes(
-                            "GET", f"http://{loc['url']}/{view.file_id}"
-                        )
-                        if status == 200:
-                            data = body
-                            break
-                    if data is None:
-                        self._lookup.invalidate(fid.volume_id)
-                        data = operation.download(self.master_url, view.file_id)
-                    # the cache (incl. its on-disk tiers) holds ciphertext only
-                    self.chunk_cache.put(view.file_id, data)
+                data = self._fetch_chunk(view.file_id)
                 if view.cipher_key:
                     from ..util import cipher as cipher_mod
 
