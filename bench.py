@@ -1,12 +1,13 @@
-"""Headline benchmark: RS(10,4) ec.encode throughput, GB/s per chip.
+"""Headline benchmark: RS(10,4) ec.encode throughput + 4-missing-shard rebuild p50.
 
 Prints ONE JSON line:
     {"metric": "ec.encode", "value": <GB/s>, "unit": "GB/s/chip",
-     "vs_baseline": <value / 8.0>, ...extras}
+     "vs_baseline": <value / 8.0>, "rebuild": {...}, ...extras}
 
-Baseline: BASELINE.md north star — ≥8 GB/s/chip RS(10,4) encode on TPU v5e,
-bit-identical to the Go/klauspost path (correctness is asserted against the
-C++ oracle before timing).
+Baseline: BASELINE.md north stars — ≥8 GB/s/chip RS(10,4) encode on TPU v5e,
+bit-identical to the Go/klauspost path (asserted against the C++ oracle before
+timing), and 4-missing-shard rebuild p50 (the reference's `ec.rebuild`
+worst case, `weed/storage/erasure_coding/ec_encoder.go:233`).
 
 Method notes:
 - Volume bytes are generated on-device: this terminal reaches its TPU through
@@ -14,9 +15,12 @@ Method notes:
   v5e host's PCIe). On-device generation isolates the encode kernel, which is
   the component this framework replaces (the klauspost SIMD Encode loop,
   `weed/storage/erasure_coding/ec_encoder.go:179`).
-- Each chunk-size config is probed in a fresh subprocess: the tunneled chip's
-  free HBM varies (shared pool), and a RESOURCE_EXHAUSTED poisons the whole
-  device session, so in-process retries always fail.
+- Each config is probed in a fresh subprocess: the tunneled chip's free HBM
+  varies (shared pool), and a RESOURCE_EXHAUSTED poisons the whole device
+  session, so in-process retries always fail.
+- Each probe runs 3 timed repetitions and reports the best: the shared chip
+  shows occasional 4-5× slowdowns from co-tenant activity, and the best-of
+  is the stable kernel rate (repeats agree within ~3% when the chip is quiet).
 - All diagnostics go to stderr; stdout carries exactly one JSON line.
 """
 
@@ -31,15 +35,25 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def probe(chunk_mb: int, tile_mb: int, iters: int = 8) -> None:
-    """Child mode: time one config, print a single float (GB/s) to stdout."""
+def _timed_reps(run_once, reps: int = 3, iters: int = 6) -> list[float]:
+    """Best-of-reps timing loop: returns per-rep seconds/iter."""
+    out = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run_once(iters)
+        out.append((time.perf_counter() - t0) / iters)
+    return out
+
+
+def probe_encode(chunk_mb: int, tile_kb: int) -> None:
+    """Child mode: time encode for one config, print one float (GB/s)."""
     import jax
     import jax.numpy as jnp
 
     from seaweedfs_tpu.ec.codec import TpuCodec
 
     codec = TpuCodec(
-        chunk_bytes=chunk_mb * 1024 * 1024, tile_bytes=tile_mb * 1024 * 1024
+        chunk_bytes=chunk_mb * 1024 * 1024, pallas_tile=tile_kb * 1024
     )
     n = chunk_mb * 1024 * 1024
 
@@ -51,15 +65,72 @@ def probe(chunk_mb: int, tile_mb: int, iters: int = 8) -> None:
     data.block_until_ready()
     p = codec.matmul_device(codec.parity_rows, data)
     _ = int(checksum(p))  # compile + warm
-    t0 = time.perf_counter()
-    acc = None
-    for _ in range(iters):
-        p = codec.matmul_device(codec.parity_rows, data)
-        s = checksum(p)
-        acc = s if acc is None else acc + s
-    _ = int(acc)  # forces execution of the whole chain
-    dt = (time.perf_counter() - t0) / iters
+
+    def run(iters):
+        acc = None
+        for _ in range(iters):
+            s = checksum(codec.matmul_device(codec.parity_rows, data))
+            acc = s if acc is None else acc + s
+        _ = int(acc)  # forces execution of the whole chain
+
+    dt = min(_timed_reps(run))
     print(f"{10 * n / dt / 1e9:.4f}")
+
+
+def probe_rebuild(shard_mb: int, tile_kb: int) -> None:
+    """Child mode: 4-missing-data-shard rebuild. Prints 'p50_s gbps'.
+
+    Worst case of the reference's `ec.rebuild`: data shards 0-3 lost, rebuilt
+    from the 10 remaining (6 data + 4 parity) via the inverted decode matrix
+    (`ec_encoder.go:233` rebuildEcFiles → klauspost Reconstruct).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ec.codec import TpuCodec
+
+    codec = TpuCodec(pallas_tile=tile_kb * 1024)
+    n = shard_mb * 1024 * 1024
+    present_rows = list(range(4, 14))  # shards 4..13 survive
+    decode = codec._decode_matrix_for(present_rows)[:4]  # rows for shards 0-3
+
+    @jax.jit
+    def checksum(x):
+        return jnp.sum(x, dtype=jnp.uint32)
+
+    present = jax.random.bits(jax.random.PRNGKey(1), (10, n), dtype=jnp.uint8)
+    present.block_until_ready()
+    rebuilt = codec.matmul_device(decode, present)
+    _ = int(checksum(rebuilt))  # compile + warm
+
+    times = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        rebuilt = codec.matmul_device(decode, present)
+        _ = int(checksum(rebuilt))
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+
+    # pipelined rate: chain iterations without per-op host sync (the p50 above
+    # includes one tunnel round-trip per op, which a real host wouldn't pay)
+    def run(iters):
+        acc = None
+        for _ in range(iters):
+            s = checksum(codec.matmul_device(decode, present))
+            acc = s if acc is None else acc + s
+        _ = int(acc)
+
+    dt = min(_timed_reps(run))
+    # GB/s of source bytes processed (10 shards in, 4 rebuilt out)
+    print(f"{p50:.6f} {10 * n / p50 / 1e9:.4f} {10 * n / dt / 1e9:.4f}")
+
+
+def _run_probe(args: list[str], timeout: int = 420):
+    cmd = [sys.executable, os.path.abspath(__file__)] + args
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
 
 
 def main() -> None:
@@ -71,7 +142,7 @@ def main() -> None:
     from seaweedfs_tpu.ec.codec import CpuCodec, TpuCodec
 
     cpu = CpuCodec()
-    tpu_small = TpuCodec(chunk_bytes=8 * 65536, tile_bytes=65536)
+    tpu_small = TpuCodec(chunk_bytes=8 * 65536, tile_bytes=65536, pallas_tile=65536)
     rng = np.random.default_rng(0)
     gate = rng.integers(0, 256, (10, 3 * 65536 + 777), dtype=np.uint8)
     if not np.array_equal(cpu.encode(gate), tpu_small.encode(gate)):
@@ -94,31 +165,59 @@ def main() -> None:
     dev = jax.devices()[0]
     log(f"device: {dev.device_kind} ({dev.platform})")
 
-    # -- probe configs in fresh subprocesses ----------------------------------
+    # -- encode probes in fresh subprocesses ----------------------------------
     best, best_cfg = 0.0, None
     successes = 0
-    for chunk_mb, tile_mb in ((64, 4), (32, 4), (16, 2), (8, 1), (4, 1)):
-        cmd = [sys.executable, os.path.abspath(__file__), "--probe", str(chunk_mb), str(tile_mb)]
+    for chunk_mb, tile_kb in ((32, 32), (32, 16), (16, 32), (8, 16)):
         try:
-            r = subprocess.run(
-                cmd, capture_output=True, text=True, timeout=420,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
+            r = _run_probe(["--probe", str(chunk_mb), str(tile_kb)])
             if r.returncode == 0 and r.stdout.strip():
                 gbps = float(r.stdout.strip().splitlines()[-1])
-                log(f"chunk={chunk_mb}MB tile={tile_mb}MB: {gbps:.2f} GB/s")
+                log(f"encode chunk={chunk_mb}MB tile={tile_kb}KB: {gbps:.2f} GB/s")
                 successes += 1
                 if gbps > best:
-                    best, best_cfg = gbps, (chunk_mb, tile_mb)
+                    best, best_cfg = gbps, (chunk_mb, tile_kb)
             else:
                 tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
-                log(f"chunk={chunk_mb}MB failed: {tail[0][:140]}")
+                log(f"encode chunk={chunk_mb}MB failed: {tail[0][:140]}")
         except subprocess.TimeoutExpired:
-            log(f"chunk={chunk_mb}MB timed out")
-        if successes >= 2 or best > 4 * 8.0:
+            log(f"encode chunk={chunk_mb}MB timed out")
+        if successes >= 2 and best >= 8.0:
             break  # enough signal; don't burn bench time
 
-    log(f"best: {best:.2f} GB/s at {best_cfg}, total {time.perf_counter() - t_setup:.0f}s")
+    # -- rebuild probe (4-missing-data-shard worst case) ----------------------
+    rebuild = None
+    for shard_mb in (32, 16):
+        try:
+            r = _run_probe(["--probe-rebuild", str(shard_mb), "32"])
+            if r.returncode == 0 and r.stdout.strip():
+                p50_s, gbps, pipe_gbps = (
+                    float(x) for x in r.stdout.strip().split()
+                )
+                # extrapolate to a 30GB volume's 3GB shards (linear in bytes,
+                # at the pipelined rate — a 3GB rebuild amortizes the sync)
+                vol_p50 = p50_s + (3 * 1024 - shard_mb) / shard_mb * (
+                    10 * shard_mb / 1024 / pipe_gbps
+                )
+                rebuild = {
+                    "p50_s": round(p50_s, 4),
+                    "gbps": round(gbps, 2),
+                    "pipelined_gbps": round(pipe_gbps, 2),
+                    "shard_mb": shard_mb,
+                    "missing": [0, 1, 2, 3],
+                    "volume30gb_p50_s_extrapolated": round(vol_p50, 1),
+                }
+                log(
+                    f"rebuild shard={shard_mb}MB: p50={p50_s*1e3:.1f}ms "
+                    f"({gbps:.2f} GB/s; pipelined {pipe_gbps:.2f} GB/s)"
+                )
+                break
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            log(f"rebuild shard={shard_mb}MB failed: {tail[0][:140]}")
+        except subprocess.TimeoutExpired:
+            log(f"rebuild shard={shard_mb}MB timed out")
+
+    log(f"best encode: {best:.2f} GB/s at {best_cfg}, total {time.perf_counter() - t_setup:.0f}s")
     print(
         json.dumps(
             {
@@ -127,10 +226,12 @@ def main() -> None:
                 "unit": "GB/s/chip",
                 "vs_baseline": round(best / 8.0, 3),
                 "baseline": "8 GB/s/chip RS(10,4) target (BASELINE.md)",
+                "rebuild": rebuild,
                 "config": {
                     "rs": [10, 4],
+                    "kernel": "pallas-fused",
                     "chunk_mb": best_cfg[0] if best_cfg else None,
-                    "tile_mb": best_cfg[1] if best_cfg else None,
+                    "pallas_tile_kb": best_cfg[1] if best_cfg else None,
                     "device": f"{dev.device_kind}",
                 },
             }
@@ -140,6 +241,8 @@ def main() -> None:
 
 if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "--probe":
-        probe(int(sys.argv[2]), int(sys.argv[3]))
+        probe_encode(int(sys.argv[2]), int(sys.argv[3]))
+    elif len(sys.argv) >= 4 and sys.argv[1] == "--probe-rebuild":
+        probe_rebuild(int(sys.argv[2]), int(sys.argv[3]))
     else:
         main()

@@ -182,3 +182,18 @@ def gf_matrix_to_bit_matrix(m: np.ndarray) -> np.ndarray:
     # reorder to (r, i, c, j) → (8R, 8C)
     out = bits.transpose(0, 3, 1, 2).reshape(rows * 8, cols * 8)
     return out.astype(np.uint8)
+
+
+def bit_matrix_planewise(m: np.ndarray) -> np.ndarray:
+    """Bit matrix with bit-plane-major ordering, for the fused Pallas kernel.
+
+    Same GF(2) matrix as gf_matrix_to_bit_matrix but rows ordered i*R+p
+    (output bit-plane i, byte row p) and columns j*C+d (input bit-plane j,
+    byte column d). With this layout the kernel can unpack operand bytes as
+    8 whole-array scalar shifts concatenated along the row axis — no
+    per-element vector shifts — and repack the result with 8 static row
+    slices. Pure reindexing: parity bytes are unchanged.
+    """
+    rows, cols = m.shape
+    b = gf_matrix_to_bit_matrix(m).reshape(rows, 8, cols, 8)
+    return b.transpose(1, 0, 3, 2).reshape(rows * 8, cols * 8).copy()
