@@ -403,7 +403,7 @@ def main(argv=None):
     v.add_argument("-dataCenter", dest="data_center", default="DefaultDataCenter")
     v.add_argument("-rack", default="DefaultRack")
     v.add_argument("-max", type=int, default=7)
-    v.add_argument("-ec.backend", dest="ec_backend", default="", choices=["", "tpu", "cpu", "numpy"])
+    v.add_argument("-ec.backend", dest="ec_backend", default="", choices=["", "tpu", "cpu", "numpy", "mesh"])
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server", help="master + volume in one process")

@@ -149,7 +149,7 @@ class TpuCodec(Codec):
     def __init__(
         self,
         *args,
-        chunk_bytes: int = 64 * 1024 * 1024,
+        chunk_bytes: int = 32 * 1024 * 1024,
         tile_bytes: int = 4 * 1024 * 1024,
         use_pallas: Optional[bool] = None,
         pallas_tile: int = 32 * 1024,
@@ -306,6 +306,14 @@ class TpuCodec(Codec):
             self._bitmat_cache[key] = cached
         return cached
 
+    def alignment(self) -> int:
+        """Column widths fed to matmul_device must be multiples of this."""
+        return self.pallas_tile if self.use_pallas else self.tile_bytes
+
+    def device_put(self, data: np.ndarray):
+        """Stage host bytes into HBM (async; the overlap pipeline's H2D leg)."""
+        return self._jax.device_put(data)
+
     def matmul_device(self, matrix: np.ndarray, data_dev):
         """Device-resident matmul: data_dev is a jax array (k, N) already in
         HBM; returns a jax array (R, N). N must be ≤ chunk and tile-aligned
@@ -357,7 +365,8 @@ def get_codec(
     **kwargs,
 ) -> Codec:
     """Codec factory. Default backend: $SWEED_EC_BACKEND or 'tpu' with jax,
-    falling back to 'cpu'."""
+    falling back to 'cpu'. 'mesh' runs SPMD over all visible devices
+    (sharded.MeshCodec)."""
     if backend is None:
         backend = os.environ.get("SWEED_EC_BACKEND", "")
     if not backend:
@@ -367,10 +376,14 @@ def get_codec(
             backend = "tpu"
         except ImportError:
             backend = "cpu"
+    if backend == "mesh":
+        from .sharded import MeshCodec  # deferred: sharded imports this module
+
+        return MeshCodec(data_shards, parity_shards, **kwargs)
     try:
         cls = _BACKENDS[backend]
     except KeyError:
-        raise ValueError(f"unknown ec backend {backend!r} (want tpu|cpu|numpy)")
+        raise ValueError(f"unknown ec backend {backend!r} (want tpu|cpu|numpy|mesh)")
     try:
         return cls(data_shards, parity_shards, **kwargs)
     except ImportError:

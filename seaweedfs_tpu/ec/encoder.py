@@ -51,6 +51,30 @@ def _read_block_columns(
     return out
 
 
+def _work_items(
+    dat_size: int, k: int, large_block_size: int, small_block_size: int, chunk: int
+):
+    """Flat (row_start, block_size, col, width) list covering the .dat in
+    shard-file append order (encodeDatFile's large-then-small row walk)."""
+    items = []
+    remaining, processed = dat_size, 0
+    while remaining > large_block_size * k:
+        for col in range(0, large_block_size, chunk):
+            items.append(
+                (processed, large_block_size, col, min(chunk, large_block_size - col))
+            )
+        remaining -= large_block_size * k
+        processed += large_block_size * k
+    while remaining > 0:
+        for col in range(0, small_block_size, chunk):
+            items.append(
+                (processed, small_block_size, col, min(chunk, small_block_size - col))
+            )
+        remaining -= small_block_size * k
+        processed += small_block_size * k
+    return items
+
+
 def write_ec_files(
     base_file_name: str,
     codec: Optional[Codec] = None,
@@ -58,50 +82,123 @@ def write_ec_files(
     small_block_size: int = SMALL_BLOCK_SIZE,
     chunk_bytes: Optional[int] = None,
 ) -> None:
-    """Generate all shard files from ``base.dat`` (WriteEcFiles, :57)."""
+    """Generate all shard files from ``base.dat`` (WriteEcFiles, :57).
+
+    Device-backed codecs (TpuCodec, MeshCodec — anything with
+    ``matmul_device``) run a 3-stage overlap pipeline: a reader thread
+    streams column chunks off disk, the main thread stages them into HBM and
+    dispatches the (async) encode kernel, and a writer thread blocks on each
+    chunk's parity and appends the 14 shard files. Disk read, H2D copy,
+    compute and file writes for neighbouring chunks overlap — the reference's
+    serial 256KB read→Encode→write loop (`ec_encoder.go:162-192`) turned into
+    a pipeline sized for a TPU. Host-only codecs keep the serial loop.
+    """
     codec = codec or get_codec()
     k, m = codec.data_shards, codec.parity_shards
     chunk = chunk_bytes or getattr(codec, "chunk_bytes", 8 * 1024 * 1024)
 
     dat = base_file_name + ".dat"
     dat_size = os.path.getsize(dat)
+    items = _work_items(dat_size, k, large_block_size, small_block_size, chunk)
 
     outputs = [open(base_file_name + shard_ext(i), "wb") for i in range(k + m)]
     try:
-        with open(dat, "rb") as f:
-            remaining = dat_size
-            processed = 0
-            while remaining > large_block_size * k:
-                _encode_row(
-                    f, processed, large_block_size, chunk, codec, outputs, dat_size
-                )
-                remaining -= large_block_size * k
-                processed += large_block_size * k
-            while remaining > 0:
-                _encode_row(
-                    f, processed, small_block_size, chunk, codec, outputs, dat_size
-                )
-                remaining -= small_block_size * k
-                processed += small_block_size * k
+        if hasattr(codec, "matmul_device"):
+            _encode_pipelined(dat, items, codec, outputs, dat_size)
+        else:
+            with open(dat, "rb") as f:
+                for start, block_size, col, width in items:
+                    data = _read_block_columns(
+                        f, start, block_size, col, width, k, dat_size
+                    )
+                    parity = codec.encode(data)
+                    for i in range(k):
+                        outputs[i].write(data[i].tobytes())
+                    for j in range(m):
+                        outputs[k + j].write(parity[j].tobytes())
     finally:
         for o in outputs:
             o.close()
 
 
-def _encode_row(
-    f, start: int, block_size: int, chunk: int, codec: Codec, outputs, dat_size: int
-) -> None:
-    k = codec.data_shards
-    col = 0
-    while col < block_size:
-        width = min(chunk, block_size - col)
-        data = _read_block_columns(f, start, block_size, col, width, k, dat_size)
-        parity = codec.encode(data)
-        for i in range(k):
-            outputs[i].write(data[i].tobytes())
-        for j in range(codec.parity_shards):
-            outputs[k + j].write(parity[j].tobytes())
-        col += width
+def _encode_pipelined(dat, items, codec, outputs, dat_size: int) -> None:
+    import queue
+    import threading
+
+    k, m = codec.data_shards, codec.parity_shards
+    align = codec.alignment() if hasattr(codec, "alignment") else 1
+    read_q: queue.Queue = queue.Queue(maxsize=2)
+    write_q: queue.Queue = queue.Queue(maxsize=2)
+    errors: list[BaseException] = []
+
+    def reader():
+        try:
+            with open(dat, "rb") as f:
+                for it in items:
+                    if errors:
+                        return
+                    start, block_size, col, width = it
+                    read_q.put(
+                        (
+                            it,
+                            _read_block_columns(
+                                f, start, block_size, col, width, k, dat_size
+                            ),
+                        )
+                    )
+        except BaseException as e:  # surfaced after join
+            errors.append(e)
+        finally:
+            read_q.put(None)
+
+    def writer():
+        try:
+            while True:
+                got = write_q.get()
+                if got is None:
+                    return
+                (_, _, _, width), data, parity_dev = got
+                parity = np.asarray(parity_dev)[:, :width]  # blocks until ready
+                for i in range(k):
+                    outputs[i].write(data[i, :width].tobytes())
+                for j in range(m):
+                    outputs[k + j].write(parity[j].tobytes())
+        except BaseException as e:
+            errors.append(e)
+            while write_q.get() is not None:  # drain so the producer can't block
+                pass
+
+    rt = threading.Thread(target=reader, daemon=True)
+    wt = threading.Thread(target=writer, daemon=True)
+    rt.start()
+    wt.start()
+    try:
+        while True:
+            got = read_q.get()
+            if got is None:
+                break
+            it, data = got
+            width = it[3]
+            piece = data
+            if width % align:
+                padded = align * -(-width // align)
+                piece = np.pad(data, ((0, 0), (0, padded - width)))
+            parity_dev = codec.matmul_device(
+                codec.parity_rows, codec.device_put(piece)
+            )
+            write_q.put((it, data, parity_dev))
+    finally:
+        write_q.put(None)
+        wt.join()
+        # unblock the reader if it is mid-put (main loop exited early)
+        while rt.is_alive():
+            try:
+                read_q.get_nowait()
+            except queue.Empty:
+                rt.join(timeout=0.05)
+        rt.join()
+    if errors:
+        raise errors[0]
 
 
 def rebuild_ec_files(
