@@ -84,6 +84,8 @@ def cmd_filer(args):
         collection=args.collection,
         replication=args.replication,
         cipher=args.encrypt_volume_data,
+        peers=[p for p in args.peers.split(",") if p],
+        meta_log_dir=args.meta_log_dir,
     ).start()
     print(f"filer on {fs.url} → master {args.master}")
     _wait_forever()
@@ -428,6 +430,17 @@ def main(argv=None):
         dest="encrypt_volume_data",
         action="store_true",
         help="AES-256-GCM encrypt chunk data (weed filer -encryptVolumeData)",
+    )
+    f.add_argument(
+        "-peers",
+        default="",
+        help="comma-separated peer filer host:port list (weed filer -peers)",
+    )
+    f.add_argument(
+        "-metaLogDir",
+        dest="meta_log_dir",
+        default="",
+        help="directory for persisted meta-log segments (default: beside -db)",
     )
     f.set_defaults(fn=cmd_filer)
 

@@ -25,9 +25,10 @@ class Filer:
         self,
         store: Optional[FilerStore] = None,
         chunk_purger: Optional[ChunkPurger] = None,
+        meta_log_dir: Optional[str] = None,
     ):
         self.store = store or MemoryStore()
-        self.meta_log = MetaLog()
+        self.meta_log = MetaLog(persist_dir=meta_log_dir)
         self.chunk_purger = chunk_purger
         # expands manifest chunks into their children before purging so
         # chunk-of-chunks files don't leak data chunks on delete/overwrite

@@ -73,6 +73,8 @@ class FilerServer:
         chunk_cache_mem_mb: int = 64,
         cipher: bool = False,
         manifest_batch: int = 1000,
+        peers: Optional[list[str]] = None,
+        meta_log_dir: str = "",
     ):
         from ..stats import default_registry
         from ..util.chunk_cache import TieredChunkCache
@@ -93,8 +95,12 @@ class FilerServer:
         self.replication = replication
         self.cipher = cipher
         self.manifest_batch = manifest_batch
+        if not meta_log_dir and db_path not in ("", ":memory:"):
+            meta_log_dir = db_path + ".metalog"  # persist beside the store
         self.filer = Filer(
-            store=SqliteStore(db_path), chunk_purger=self._purge_chunks
+            store=SqliteStore(db_path),
+            chunk_purger=self._purge_chunks,
+            meta_log_dir=meta_log_dir or None,
         )
         self.filer.chunk_resolver = self._resolve_chunks
         from ..filer.filer_conf import FILER_CONF_PATH, FilerConf
@@ -109,6 +115,17 @@ class FilerServer:
         self._srv = None
         # cluster-sync loop-prevention signature (filer.go Signature)
         self.signature = random.getrandbits(31)
+        # register our signature in the store so peers sharing it can tell
+        # (meta_aggregator.go:43 store-sharing detection)
+        from ..filer.meta_aggregator import PEER_SIG_PREFIX, MetaAggregator
+
+        self.filer.store.kv_put(
+            PEER_SIG_PREFIX + str(self.signature).encode(),
+            f"{host}:{port}".encode(),
+        )
+        self.meta_aggregator = MetaAggregator(
+            self.filer, f"{host}:{port}", peers or []
+        )
 
     def _purge_chunks(self, fids: list[str]) -> None:
         t = threading.Thread(
@@ -142,25 +159,32 @@ class FilerServer:
             "auth": a.auth,
         }
 
-    def _h_meta_events(self, h, path, q, body):
-        """SubscribeMetadata analog: poll events after since_ns
-        (server/filer_grpc_server_sub_meta.go)."""
+    def _meta_reply(self, log, q):
         since = int(q.get("since_ns", 0))
         limit = int(q.get("limit", 1000))
-        events = self.filer.meta_log.replay_since(since)[:limit]
-        out = [
-            {
-                "ts_ns": e.ts_ns,
-                "directory": e.directory,
-                "old_entry": e.old_entry,
-                "new_entry": e.new_entry,
-                "delete_chunks": e.delete_chunks,
-                "signatures": e.signatures,
-            }
-            for e in events
-        ]
+        wait_s = min(float(q.get("wait_s", 0)), 30.0)
+        events = log.wait_since(since, timeout=wait_s)[:limit]
+        out = [e.to_dict() for e in events]
         last = out[-1]["ts_ns"] if out else since
-        return 200, {"events": out, "last_ts_ns": last}
+        return 200, {
+            "events": out,
+            "last_ts_ns": last,
+            # since_ns below this means history was pruned → client must
+            # resync from a snapshot (round-1 rings lost this signal)
+            "oldest_ts_ns": log.oldest_ts_ns(),
+        }
+
+    def _h_meta_events(self, h, path, q, body):
+        """SubscribeLocalMetadata analog: this filer's own mutations, replayed
+        from the persisted log then tailed, with optional long-poll
+        (server/filer_grpc_server_sub_meta.go:61)."""
+        return self._meta_reply(self.filer.meta_log, q)
+
+    def _h_meta_watch(self, h, path, q, body):
+        """SubscribeMetadata analog: the cluster-wide aggregated feed — own
+        mutations plus every peer's, merged by the MetaAggregator
+        (server/filer_grpc_server_sub_meta.go:17)."""
+        return self._meta_reply(self.meta_aggregator.feed, q)
 
     def _h_kv(self, h, path, q, body):
         key = path[len("/_kv/") :].encode()
@@ -520,6 +544,7 @@ class FilerServer:
             routes = [
                 ("GET", "/_assign", fs._h_assign),
                 ("GET", "/_meta/events", fs._h_meta_events),
+                ("GET", "/_meta/watch", fs._h_meta_watch),
                 ("GET", "/_status", fs._h_status),
                 ("GET", "/metrics", fs._h_metrics),
                 ("POST", "/_query", fs._h_query),
@@ -534,13 +559,16 @@ class FilerServer:
             ]
 
         self._srv = start_server(Handler, self.host, self.port)
+        self.meta_aggregator.start()
         return self
 
     def stop(self):
+        self.meta_aggregator.stop()
         self._master_client.stop()
         if self._srv:
             self._srv.shutdown()
             self._srv.server_close()
+        self.filer.meta_log.close()
         self.filer.store.close()
 
     @property

@@ -1,0 +1,186 @@
+"""Persisted meta log + MetaAggregator: multi-filer metadata convergence.
+
+Covers VERDICT round-1 gaps #2 (in-memory-only meta log: restart lost
+history, two filers couldn't share) and weak #5 (no gap signal): persisted
+segment replay across restart, two filer daemons over one store converging
+via `/_meta/watch`, two daemons over independent stores replicating entries,
+and pruning surfacing a gap to late subscribers.
+Reference: weed/filer/filer_notify.go:18,84, meta_aggregator.go:31-49.
+"""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.meta_log import MetaLog
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- persisted log ------------------------------------------------------------
+def test_meta_log_survives_restart(tmp_path):
+    d = str(tmp_path / "metalog")
+    log = MetaLog(persist_dir=d, segment_events=3)
+    for i in range(8):  # spans 3 segments
+        log.append(f"/dir{i}", None, {"full_path": f"/dir{i}/f"})
+    seqs = [e.seq for e in log.replay_since(0)]
+    assert seqs == list(range(1, 9))
+    log.close()
+
+    log2 = MetaLog(persist_dir=d, segment_events=3)
+    replayed = log2.replay_since(0)
+    assert [e.seq for e in replayed] == list(range(1, 9))
+    assert replayed[3].new_entry == {"full_path": "/dir3/f"}
+    # seq numbering resumes, no collisions
+    ev = log2.append("/x", None, {"full_path": "/x/y"})
+    assert ev.seq == 9
+    log2.close()
+
+
+def test_meta_log_replay_since_mid_timestamp(tmp_path):
+    log = MetaLog(persist_dir=str(tmp_path / "m"), segment_events=2)
+    for i in range(6):
+        log.append(f"/d{i}", None, None)
+    cut = log.replay_since(0)[2].ts_ns
+    later = log.replay_since(cut)
+    assert [e.seq for e in later] == [4, 5, 6]
+    log.close()
+
+
+def test_meta_log_prune_signals_gap(tmp_path):
+    log = MetaLog(persist_dir=str(tmp_path / "m"), segment_events=2)
+    for i in range(10):
+        log.append(f"/d{i}", None, None)
+    assert log.oldest_ts_ns() == 0  # nothing pruned yet: full history
+    log.prune_segments(keep=2)
+    oldest = log.oldest_ts_ns()
+    assert oldest > 0  # early history gone → subscribers at 0 must resync
+    log.close()
+
+
+def test_filer_meta_log_dir_wiring(tmp_path):
+    f = Filer(meta_log_dir=str(tmp_path / "ml"))
+    f.create_entry(Entry(full_path="/a/b.txt"))
+    f2 = Filer(meta_log_dir=str(tmp_path / "ml"))
+    evs = f2.meta_log.replay_since(0)
+    paths = [e.new_entry["full_path"] for e in evs if e.new_entry]
+    assert "/a/b.txt" in paths
+
+
+# -- aggregation --------------------------------------------------------------
+@pytest.fixture()
+def master(tmp_path):
+    m = MasterServer(port=free_port(), node_timeout=60).start()
+    v = VolumeServer(
+        [str(tmp_path / "vols")],
+        port=free_port(),
+        master_url=m.url,
+        max_volume_count=10,
+        pulse_seconds=0.5,
+    ).start()
+    time.sleep(0.6)
+    yield m
+    v.stop()
+    m.stop()
+
+
+def _wait_for(pred, timeout=10.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_two_filers_shared_store_watch(master, tmp_path):
+    """Two filer daemons over ONE sqlite store: a mutation on A appears on
+    B's aggregated watch feed (and is not double-applied to the store)."""
+    db = str(tmp_path / "shared.db")
+    pa, pb = free_port(), free_port()
+    a = FilerServer(port=pa, master_url=master.url, db_path=db,
+                    peers=[f"127.0.0.1:{pb}"]).start()
+    b = FilerServer(port=pb, master_url=master.url, db_path=db,
+                    peers=[f"127.0.0.1:{pa}"]).start()
+    try:
+        status, _ = http_bytes("POST", f"http://{a.url}/shared/x.txt", b"hello")
+        assert status == 201
+
+        def seen_on_b():
+            r = http_json("GET", f"http://{b.url}/_meta/watch?since_ns=0")
+            return any(
+                (e.get("new_entry") or {}).get("full_path") == "/shared/x.txt"
+                for e in r["events"]
+            )
+
+        assert _wait_for(seen_on_b), "mutation on A never reached B's watch"
+        # shared store: B reads the entry because the store is the same
+        status, data = http_bytes("GET", f"http://{b.url}/shared/x.txt")
+        assert status == 200 and data == b"hello"
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_two_filers_separate_stores_replicate(master, tmp_path):
+    """Independent stores: the aggregator replays peer events into the local
+    store, so a metadata entry created on A becomes findable on B."""
+    pa, pb = free_port(), free_port()
+    a = FilerServer(port=pa, master_url=master.url,
+                    db_path=str(tmp_path / "a.db"),
+                    peers=[f"127.0.0.1:{pb}"]).start()
+    b = FilerServer(port=pb, master_url=master.url,
+                    db_path=str(tmp_path / "b.db"),
+                    peers=[f"127.0.0.1:{pa}"]).start()
+    try:
+        status, _ = http_bytes("POST", f"http://{a.url}/repl/x.txt", b"peer data")
+        assert status == 201
+
+        def entry_on_b():
+            try:
+                b.filer.find_entry("/repl/x.txt")
+                return True
+            except Exception:
+                return False
+
+        assert _wait_for(entry_on_b), "peer event never applied to B's store"
+        # chunks live on the shared volume cluster, so B serves the content
+        status, data = http_bytes("GET", f"http://{b.url}/repl/x.txt")
+        assert status == 200 and data == b"peer data"
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_watch_survives_filer_restart(master, tmp_path):
+    """Persisted log: a restarted filer still serves pre-restart history to
+    subscribers (the round-1 ring lost it)."""
+    db = str(tmp_path / "f.db")
+    port = free_port()
+    a = FilerServer(port=port, master_url=master.url, db_path=db).start()
+    status, _ = http_bytes("POST", f"http://{a.url}/keep/me.txt", b"x")
+    assert status == 201
+    a.stop()
+
+    a2 = FilerServer(port=port, master_url=master.url, db_path=db).start()
+    try:
+        r = http_json("GET", f"http://{a2.url}/_meta/events?since_ns=0")
+        paths = [
+            (e.get("new_entry") or {}).get("full_path") for e in r["events"]
+        ]
+        assert "/keep/me.txt" in paths
+    finally:
+        a2.stop()
