@@ -239,31 +239,33 @@ class Filer:
         ignore_recursive_error: bool,
         signatures: Optional[list[int]] = None,
     ) -> list[str]:
-        entry = self.store.find_entry(path)
-        fids: list[str] = []
-        if entry.hard_link_id:
-            # unlink: drop the stub, decrement the inode's counter; chunks
-            # are purged only when the last link goes away
-            import json as _json
-
-            hid = entry.hard_link_id
-            inode = self._resolve_hardlink(entry)
-            counter = inode.hard_link_counter - 1
-            self.store.delete_entry(path)
-            if counter <= 0:
-                self.store.kv_put(self._hardlink_key(hid), b"")
-                fids = self._fids(inode.chunks)
-            else:
-                self._write_hardlink_content(hid, inode, counter)
-            self.meta_log.append(
-                entry.parent,
-                inode.to_dict() | {"full_path": path},
-                None,
-                delete_chunks=bool(fids),
-                signatures=signatures,
-            )
-            return fids
         with self._lock:
+            entry = self.store.find_entry(path)
+            fids = []
+            if entry.hard_link_id:
+                # unlink: drop the stub, decrement the inode's counter;
+                # chunks are purged only when the last link goes away. The
+                # counter read-modify-write and the inode content update
+                # must be serialized with create_entry/link through other
+                # link paths (two racing unlinks would otherwise both read
+                # the same counter and leak the chunks forever).
+                hid = entry.hard_link_id
+                inode = self._resolve_hardlink(entry)
+                counter = inode.hard_link_counter - 1
+                self.store.delete_entry(path)
+                if counter <= 0:
+                    self.store.kv_put(self._hardlink_key(hid), b"")
+                    fids = self._fids(inode.chunks)
+                else:
+                    self._write_hardlink_content(hid, inode, counter)
+                self.meta_log.append(
+                    entry.parent,
+                    inode.to_dict() | {"full_path": path},
+                    None,
+                    delete_chunks=bool(fids),
+                    signatures=signatures,
+                )
+                return fids
             if entry.is_directory:
                 children = list(self.store.list_entries(path, limit=1_000_000))
                 if children and not recursive:

@@ -79,6 +79,10 @@ class MetaAggregator:
             t.join(timeout=0.2)  # long-poll that's still in flight
 
     def _on_self_event(self, ev: EventNotification) -> None:
+        # the feed RE-STAMPS with local receive time (the reference does the
+        # same when republishing): watch cursors are ts-based, so carrying a
+        # peer's older origin ts would make late-arriving peer events sort
+        # behind a cursor already advanced by our own events — lost forever
         self.feed.append(
             ev.directory,
             ev.old_entry,
@@ -86,7 +90,6 @@ class MetaAggregator:
             delete_chunks=ev.delete_chunks,
             signatures=ev.signatures,
             is_from_other_cluster=ev.is_from_other_cluster,
-            ts_ns=ev.ts_ns,
         )
 
     # -- peer following ------------------------------------------------------
@@ -101,6 +104,8 @@ class MetaAggregator:
     def _offset_key(self, peer: str) -> bytes:
         return OFFSET_PREFIX + peer.encode()
 
+    _MAX_APPLY_RETRIES = 5
+
     def _follow_peer(self, peer: str) -> None:
         from ..server.http_util import http_json
 
@@ -108,6 +113,7 @@ class MetaAggregator:
         shares_store: Optional[bool] = None
         since = int(store.kv_get(self._offset_key(peer)) or 0)
         backoff = 0.2
+        apply_failures: dict[int, int] = {}  # peer seq -> consecutive failures
         while not self._stop.is_set():
             try:
                 if shares_store is None:
@@ -134,13 +140,26 @@ class MetaAggregator:
                 # start of what it still has (upserts make replay idempotent)
                 since = 0
             events = r.get("events", [])
+            applied_any = False
+            stalled = False
             for d in events:
                 ev = EventNotification.from_dict(d)
                 if shares_store is False:
                     try:
                         apply_event_to_store(store, ev)
+                        apply_failures.pop(ev.seq, None)
                     except Exception:
-                        pass
+                        # do NOT advance past an unapplied event — that is
+                        # silent store divergence. Retry it on the next poll
+                        # (transient store errors heal); a poison event is
+                        # skipped after _MAX_APPLY_RETRIES so one bad record
+                        # can't stall the whole peer stream.
+                        n = apply_failures.get(ev.seq, 0) + 1
+                        apply_failures[ev.seq] = n
+                        if n <= self._MAX_APPLY_RETRIES:
+                            stalled = True
+                            break
+                        apply_failures.pop(ev.seq, None)
                 self.feed.append(
                     ev.directory,
                     ev.old_entry,
@@ -148,8 +167,12 @@ class MetaAggregator:
                     delete_chunks=ev.delete_chunks,
                     signatures=ev.signatures,
                     is_from_other_cluster=ev.is_from_other_cluster,
-                    ts_ns=ev.ts_ns,
                 )
                 since = max(since, ev.ts_ns)
-            if events:
+                applied_any = True
+            if applied_any:
                 store.kv_put(self._offset_key(peer), str(since).encode())
+            if stalled:
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 5.0)

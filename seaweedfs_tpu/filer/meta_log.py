@@ -80,6 +80,7 @@ class MetaLog:
         self._cond = threading.Condition(self._lock)
         self._subscribers: dict[str, Callable[[EventNotification], None]] = {}
         self._next_seq = 1
+        self._last_ts_ns = 0
         self._seg_fh = None
         self._seg_count = 0
         # (seq, ts) of the oldest surviving persisted event; a first seq > 1
@@ -106,13 +107,16 @@ class MetaLog:
             return
         self._load_oldest(segs)
         # last seq: last line of the last segment
-        last_seq = 0
+        last_seq = last_ts = 0
         with open(os.path.join(self.persist_dir, segs[-1])) as f:
             for line in f:
                 line = line.strip()
                 if line:
-                    last_seq = json.loads(line)["seq"]
+                    d = json.loads(line)
+                    last_seq, last_ts = d["seq"], d["ts_ns"]
         self._next_seq = last_seq + 1
+        # keep ts monotone across restarts too (clock may have stepped back)
+        self._last_ts_ns = last_ts
 
     def _persist(self, ev: EventNotification) -> None:
         if not self.persist_dir:
@@ -180,10 +184,9 @@ class MetaLog:
         delete_chunks: bool = False,
         signatures: Optional[list[int]] = None,
         is_from_other_cluster: bool = False,
-        ts_ns: Optional[int] = None,
     ) -> EventNotification:
         ev = EventNotification(
-            ts_ns=ts_ns if ts_ns is not None else time.time_ns(),
+            ts_ns=0,
             directory=directory,
             old_entry=old_entry,
             new_entry=new_entry,
@@ -192,6 +195,11 @@ class MetaLog:
             signatures=signatures or [],
         )
         with self._lock:
+            # stamp under the lock so ts order always matches seq order —
+            # a pre-lock stamp lets a preempted thread append an OLDER ts
+            # after a newer one, and ts-cursor pollers then skip it forever
+            ev.ts_ns = max(time.time_ns(), self._last_ts_ns + 1)
+            self._last_ts_ns = ev.ts_ns
             ev.seq = self._next_seq
             self._next_seq += 1
             self._events.append(ev)
@@ -225,7 +233,14 @@ class MetaLog:
         with self._lock:
             mem = [e for e in self._events if e.ts_ns > ts_ns]
             mem_seqs = {e.seq for e in mem}
-        if self.persist_dir:
+            # memory fast path: ts is monotone with seq, so when the ring's
+            # oldest event is at or before the cursor (or the ring still holds
+            # seq 1), everything after the cursor is in memory — skip the
+            # full-segment disk scan that would otherwise run on every poll
+            ring_covers = bool(self._events) and (
+                self._events[0].seq == 1 or self._events[0].ts_ns <= ts_ns
+            )
+        if self.persist_dir and not ring_covers:
             disk = [
                 e for e in self._read_persisted(ts_ns) if e.seq not in mem_seqs
             ]

@@ -39,11 +39,21 @@ class WFS:
         ttl: str = "",
         meta_cache_db: str = ":memory:",
         use_meta_cache: bool = True,
+        cipher: Optional[bool] = None,
     ):
         self.client = FilerClient(filer_url)
         self.chunk_size = chunk_size
         self.collection = collection
         self.ttl = ttl
+        if cipher is None:
+            # honor the filer's -encryptVolumeData setting the way the
+            # reference mount reads GetFilerConfiguration (wfs.go:55) —
+            # otherwise every mount write silently bypasses encryption
+            try:
+                cipher = bool(self.client.status().get("cipher", False))
+            except Exception:
+                cipher = False
+        self.cipher = cipher
         self.meta_cache: Optional[MetaCache] = None
         if use_meta_cache:
             self.meta_cache = MetaCache(filer_url, meta_cache_db).start()
@@ -141,13 +151,25 @@ class WFS:
             a = self.client.assign(collection=self.collection, ttl=self.ttl)
             if a.get("error"):
                 raise WfsError(f"assign: {a['error']}")
-            operation.upload_data(a["url"], a["fid"], piece, jwt=a.get("auth", ""))
+            payload, cipher_key_b64 = piece, ""
+            if self.cipher:
+                # fresh key per chunk; the volume stores ciphertext and the
+                # entry holds the key, same as filer POST (_write_cipher.go)
+                import base64
+
+                from ..util import cipher as cipher_mod
+
+                key = cipher_mod.gen_cipher_key()
+                payload = cipher_mod.encrypt(piece, key)
+                cipher_key_b64 = base64.b64encode(key).decode()
+            operation.upload_data(a["url"], a["fid"], payload, jwt=a.get("auth", ""))
             chunks.append(
                 FileChunk(
                     file_id=a["fid"],
                     offset=base_offset + pos,
                     size=len(piece),
                     mtime=time.time_ns(),
+                    cipher_key=cipher_key_b64,
                 )
             )
             pos += len(piece)

@@ -96,7 +96,10 @@ class FilerServer:
         self.cipher = cipher
         self.manifest_batch = manifest_batch
         if not meta_log_dir and db_path not in ("", ":memory:"):
-            meta_log_dir = db_path + ".metalog"  # persist beside the store
+            # persist beside the store, but per-filer: two filers SHARING one
+            # store (a supported topology) must not interleave segments or
+            # collide on seq numbering in a common directory
+            meta_log_dir = f"{db_path}.metalog.{port}"
         self.filer = Filer(
             store=SqliteStore(db_path),
             chunk_purger=self._purge_chunks,
@@ -112,6 +115,7 @@ class FilerServer:
         # master round-trip unless the vid is genuinely unknown
         self._master_client = MasterClient(master_url, f"filer@{host}:{port}").start()
         self._lookup = _VidLookup(self._master_client)
+        self._load_filer_conf()
         self._srv = None
         # cluster-sync loop-prevention signature (filer.go Signature)
         self.signature = random.getrandbits(31)
@@ -126,6 +130,25 @@ class FilerServer:
         self.meta_aggregator = MetaAggregator(
             self.filer, f"{host}:{port}", peers or []
         )
+
+    def _load_filer_conf(self) -> None:
+        """Read /etc/seaweedfs/filer.conf through the filer and swap the
+        active rule set (filer.go LoadFilerConf — reference loads at startup
+        and on every change to the conf entry)."""
+        from ..filer.filer_conf import FilerConf
+
+        try:
+            entry = self.filer.find_entry(self._conf_path)
+            data = self._read_range(entry, 0, entry.file_size())
+        except NotFoundError:
+            data = b""
+        except Exception:
+            return  # unreadable conf keeps the last good rule set
+        self.filer_conf = FilerConf.from_bytes(data)
+
+    def _maybe_reload_conf(self, *paths: str) -> None:
+        if any(p == self._conf_path for p in paths):
+            self._load_filer_conf()
 
     def _purge_chunks(self, fids: list[str]) -> None:
         t = threading.Thread(
@@ -201,6 +224,9 @@ class FilerServer:
             "signature": self.signature,
             "url": self.url,
             "master": self.master_url,
+            # GetFilerConfiguration analog: mount/sync clients must know to
+            # encrypt their chunks when the filer runs -encryptVolumeData
+            "cipher": self.cipher,
             "chunk_cache": {
                 "hits": self.chunk_cache.mem.hits,
                 "misses": self.chunk_cache.mem.misses,
@@ -245,6 +271,7 @@ class FilerServer:
         path = urllib.parse.unquote(path)
         if q.get("mv.to"):
             entry = self.filer.rename(path.rstrip("/") or "/", q["mv.to"])
+            self._maybe_reload_conf(path.rstrip("/"), q["mv.to"])
             return 200, {"name": entry.name, "path": entry.full_path}
         if q.get("link.to"):
             # hardlink: this path becomes another name for link.to's inode
@@ -256,6 +283,7 @@ class FilerServer:
             entry = self.filer.create_entry(
                 Entry.from_dict(d), signatures=self._sigs(q)
             )
+            self._maybe_reload_conf(entry.full_path)
             return 201, {"name": entry.name}
         if path.endswith("/"):
             if q.get("mkdir") == "true":
@@ -334,6 +362,7 @@ class FilerServer:
             extended=extended,
         )
         self.filer.create_entry(entry, signatures=self._sigs(q))
+        self._maybe_reload_conf(path)
         return 201, {
             "name": entry.name,
             "size": len(body),
@@ -533,6 +562,7 @@ class FilerServer:
             return 404, {"error": f"{path} not found"}
         except OSError as e:
             return 409, {"error": str(e)}
+        self._maybe_reload_conf(path)
         # 200 with body, not 204: a 204 must not carry one (keep-alive framing)
         return 200, {"purged_chunks": len(fids)}
 

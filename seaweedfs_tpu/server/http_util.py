@@ -106,9 +106,18 @@ def http_json(
 def http_bytes(
     method: str, url: str, body: Optional[bytes] = None, timeout: float = 30.0
 ) -> tuple[int, bytes]:
+    status, data, _ = http_bytes_headers(method, url, body=body, timeout=timeout)
+    return status, data
+
+
+def http_bytes_headers(
+    method: str, url: str, body: Optional[bytes] = None, timeout: float = 30.0
+) -> tuple[int, bytes, dict]:
+    """Like http_bytes but also returns response headers (some admin
+    endpoints carry metadata such as X-Compaction-Revision there)."""
     req = urllib.request.Request(url, data=body, method=method)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, resp.read()
+            return resp.status, resp.read(), dict(resp.headers)
     except urllib.error.HTTPError as e:
-        return e.code, e.read()
+        return e.code, e.read(), dict(e.headers)

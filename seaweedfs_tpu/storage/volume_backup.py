@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import os
 
-from ..server.http_util import http_bytes, http_json
+from ..server.http_util import http_bytes, http_bytes_headers, http_json
 from .needle import Needle, parse_needle_header
 from .needle import NEEDLE_HEADER_SIZE  # re-exported there
 from .volume import Volume, volume_file_name
@@ -87,16 +87,32 @@ def backup_volume(
                 f.truncate(indexed_end)
             start = indexed_end
     copied = 0
+    start_rev = st["compaction_revision"]
     with open(base + ".dat", "ab") as f:
         offset = start
         while True:
-            status, page = http_bytes(
+            status, page, hdrs = http_bytes_headers(
                 "GET",
                 f"http://{src}/admin/incremental_copy?volume={vid}"
                 f"&offset={offset}&max_bytes={PAGE_BYTES}",
             )
             if status != 200:
                 raise RuntimeError(f"incremental copy from {src}: HTTP {status}")
+            # a vacuum committing mid-run rewrites the source .dat: bytes at
+            # these offsets are no longer a prefix of our copy. Abort before
+            # appending garbage; the next run's revision check wipes and
+            # restarts from 0 (volume_backup.go revision fencing per page).
+            page_rev = int(hdrs.get("X-Compaction-Revision", start_rev))
+            if page_rev != start_rev:
+                # bytes copied this run straddle revisions — drop them all,
+                # leaving the local copy exactly as before the run
+                f.truncate(start)
+                f.flush()
+                os.fsync(f.fileno())
+                raise RuntimeError(
+                    f"volume {vid} compacted mid-backup "
+                    f"(revision {start_rev} -> {page_rev}); rerun to restart"
+                )
             if not page:
                 break
             f.write(page)

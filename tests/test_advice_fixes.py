@@ -1,0 +1,239 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. FilerConf is loaded from the stored /etc/seaweedfs/filer.conf entry at
+   startup and reloaded when that entry changes (filer_conf.go).
+2. Mount (WFS) honors the filer's cipher setting: chunks written through
+   the mount are encrypted like filer-POST writes (_write_cipher.go).
+3. Hardlink unlink is serialized with the filer lock: concurrent unlinks
+   can neither leak chunks nor double-purge (filerstore_hardlink.go).
+4. backup_volume fences every page on X-Compaction-Revision: a vacuum
+   committing mid-backup aborts the run instead of corrupting the copy
+   (volume_backup.go).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.filer.entry import Entry, FileChunk
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage import volume_backup as vb
+from seaweedfs_tpu.storage.volume import volume_file_name
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("advicefix")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp / "v")],
+        port=free_port(),
+        master_url=master.url,
+        max_volume_count=20,
+        pulse_seconds=0.5,
+    ).start()
+    yield master, volume
+    volume.stop()
+    master.stop()
+
+
+# -- 1. FilerConf load + reload ---------------------------------------------
+
+
+def test_filer_conf_loaded_and_reloaded(cluster):
+    master, _ = cluster
+    filer = FilerServer(
+        port=free_port(), master_url=master.url, chunk_size=64 * 1024
+    ).start()
+    try:
+        conf = {
+            "locations": [
+                {"location_prefix": "/media/", "collection": "media", "ttl": ""}
+            ]
+        }
+        status, _ = http_bytes(
+            "POST",
+            f"http://{filer.url}/etc/seaweedfs/filer.conf",
+            json.dumps(conf).encode(),
+        )
+        assert status == 201
+        # writing the conf entry must hot-swap the active rule set
+        rule = filer.filer_conf.match_storage_rule("/media/x.jpg")
+        assert rule.collection == "media"
+        # and a write under the prefix actually lands in that collection
+        status, _ = http_bytes(
+            "POST", f"http://{filer.url}/media/x.jpg", b"image bytes"
+        )
+        assert status == 201
+        meta = http_json("GET", f"http://{filer.url}/media/x.jpg?meta=true")
+        assert meta["collection"] == "media"
+        # a filer restarted over the same store must load the conf at startup
+        filer2 = FilerServer(
+            port=free_port(), master_url=master.url, chunk_size=64 * 1024
+        )
+        try:
+            # fresh in-memory store has no conf — simulate persistence by
+            # pointing the second filer at the first one's live store
+            filer2.filer = filer.filer
+            filer2._load_filer_conf()
+            assert (
+                filer2.filer_conf.match_storage_rule("/media/y.jpg").collection
+                == "media"
+            )
+        finally:
+            filer2._master_client.stop()
+        # deleting the conf entry drops the rules
+        http_bytes("DELETE", f"http://{filer.url}/etc/seaweedfs/filer.conf")
+        assert filer.filer_conf.match_storage_rule("/media/x.jpg").collection == ""
+    finally:
+        filer.stop()
+
+
+# -- 2. Mount honors filer cipher -------------------------------------------
+
+
+def test_mount_honors_filer_cipher(cluster):
+    from seaweedfs_tpu.mount.wfs import WFS
+
+    master, _ = cluster
+    filer = FilerServer(
+        port=free_port(), master_url=master.url, chunk_size=64 * 1024, cipher=True
+    ).start()
+    try:
+        wfs = WFS(filer.url, chunk_size=32 * 1024, use_meta_cache=False)
+        assert wfs.cipher is True  # auto-detected from /_status
+        payload = b"mount secret " * 1000
+        wfs.write_file("/sec/mnt.bin", payload)
+        assert wfs.read_file("/sec/mnt.bin") == payload
+        status, data = http_bytes("GET", f"http://{filer.url}/sec/mnt.bin")
+        assert status == 200 and data == payload
+        meta = http_json("GET", f"http://{filer.url}/sec/mnt.bin?meta=true")
+        chunks = meta["chunks"]
+        assert chunks and all(c.get("cipher_key") for c in chunks)
+        # the stored chunk bytes must NOT be the plaintext piece
+        fid = chunks[0]["file_id"]
+        vid = int(fid.split(",")[0])
+        locs = http_json(
+            "GET", f"http://{master.url}/dir/lookup?volumeId={vid}"
+        )["locations"]
+        status, raw = http_bytes("GET", f"http://{locs[0]['url']}/{fid}")
+        assert status == 200
+        assert raw != payload[: len(raw)]
+        assert payload[:32] not in raw
+        wfs.close()
+    finally:
+        filer.stop()
+
+
+# -- 3. Hardlink unlink races ------------------------------------------------
+
+
+def test_hardlink_concurrent_unlink_no_leak():
+    purged: list[str] = []
+    purge_lock = threading.Lock()
+
+    def purger(fids):
+        with purge_lock:
+            purged.extend(fids)
+
+    filer = Filer(chunk_purger=purger)
+    chunks = [FileChunk(file_id=f"7,fid{i:02x}", offset=i * 10, size=10) for i in range(4)]
+    filer.create_entry(Entry(full_path="/h/base", chunks=list(chunks)))
+    n_links = 8
+    for i in range(n_links):
+        filer.link("/h/base", f"/h/link{i}")
+    paths = ["/h/base"] + [f"/h/link{i}" for i in range(n_links)]
+
+    barrier = threading.Barrier(len(paths))
+    errors: list[Exception] = []
+
+    def unlink(p):
+        barrier.wait()
+        try:
+            filer.delete_entry(p)
+        except Exception as e:  # lost-update races surface as NotFound/etc
+            errors.append(e)
+
+    threads = [threading.Thread(target=unlink, args=(p,)) for p in paths]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    # every chunk purged exactly once — no leak, no double purge
+    assert sorted(purged) == sorted(c.file_id for c in chunks)
+    # the shared inode KV slot is cleared
+    hid_entries = [
+        p for p in paths if _exists(filer, p)
+    ]
+    assert not hid_entries
+
+
+def _exists(filer, path):
+    from seaweedfs_tpu.filer.filerstore import NotFoundError
+
+    try:
+        filer.find_entry(path)
+        return True
+    except NotFoundError:
+        return False
+
+
+# -- 4. Backup fences on mid-run compaction ----------------------------------
+
+
+def test_backup_aborts_on_midrun_compaction(cluster, tmp_path, monkeypatch):
+    master, _ = cluster
+    backup_dir = str(tmp_path / "bk")
+    os.makedirs(backup_dir)
+    fids = [operation.submit(master.url, f"rev fence {i}".encode()) for i in range(6)]
+    vid = int(fids[0].split(",")[0])
+    r = vb.backup_volume(master.url, vid, backup_dir)
+    base = volume_file_name(backup_dir, "", vid)
+    # append more data ON THIS VOLUME so the next run has bytes to copy
+    added, i = 0, 0
+    while added < 3 and i < 300:
+        f = operation.submit(master.url, f"post-backup {i}".encode())
+        if f.startswith(f"{vid},"):
+            added += 1
+        i += 1
+    assert added >= 3
+    pre_size = os.path.getsize(base + ".dat")
+
+    real = vb.http_bytes_headers
+    calls = {"n": 0}
+
+    def shim(method, url, body=None, timeout=30.0):
+        status, page, hdrs = real(method, url, body=body, timeout=timeout)
+        calls["n"] += 1
+        if calls["n"] >= 2:  # fake a vacuum commit between pages
+            rev = int(hdrs.get("X-Compaction-Revision", "0"))
+            hdrs = dict(hdrs) | {"X-Compaction-Revision": str(rev + 1)}
+        return status, page, hdrs
+
+    monkeypatch.setattr(vb, "http_bytes_headers", shim)
+    with pytest.raises(RuntimeError, match="compacted mid-backup"):
+        vb.backup_volume(master.url, vid, backup_dir)
+    # the aborted run left the local copy exactly as before
+    assert os.path.getsize(base + ".dat") == pre_size
+    monkeypatch.undo()
+    # a clean rerun converges
+    r = vb.backup_volume(master.url, vid, backup_dir)
+    assert r["writes"] >= 3 and r["copied_bytes"] > 0
