@@ -38,6 +38,7 @@ from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import MAX_INT64, view_from_chunks
 from ..filer.filer import Filer
 from ..filer.filerstore import NotFoundError, SqliteStore
+from ..util import glog
 from ..wdclient import MasterClient
 from .http_util import JsonHandler, start_server
 
@@ -589,6 +590,8 @@ class FilerServer:
             ]
 
         self._srv = start_server(Handler, self.host, self.port)
+        glog.info("filer up on %s:%d → master %s", self.host, self.port,
+                  self.master_url)
         self.meta_aggregator.start()
         return self
 

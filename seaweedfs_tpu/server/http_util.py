@@ -9,6 +9,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
+from seaweedfs_tpu.util import glog
+
 
 class JsonHandler(BaseHTTPRequestHandler):
     """Route table based handler; subclasses set `routes` as
@@ -20,8 +22,8 @@ class JsonHandler(BaseHTTPRequestHandler):
     server_ctx: Any = None
     extra_headers: Optional[dict] = None  # handlers may set per-request
 
-    def log_message(self, fmt, *args):  # quiet
-        pass
+    def log_message(self, fmt, *args):  # stdlib chatter → V(3)
+        glog.V(3).info("http: " + fmt, *args)
 
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
@@ -33,7 +35,9 @@ class JsonHandler(BaseHTTPRequestHandler):
                 try:
                     status, payload = fn(self, parsed.path, query, body)
                 except Exception as e:
+                    glog.exception("%s %s failed", method, parsed.path)
                     status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+                glog.V(2).info("%s %s → %d", method, parsed.path, status)
                 self._reply(status, payload, head_only=(method == "HEAD"))
                 return
         self._reply(404, {"error": f"no route {method} {parsed.path}"})

@@ -1,0 +1,87 @@
+"""Leveled logging (util/glog.py — the weed/glog/glog.go analog)."""
+
+import sys
+
+import pytest
+
+from seaweedfs_tpu.util import glog
+
+
+@pytest.fixture(autouse=True)
+def reset_glog():
+    yield
+    glog.set_verbosity(0)
+    glog.set_vmodule("")
+    glog.set_output(to_stderr=True, log_dir="", stderr_threshold="ERROR")
+
+
+def test_severity_line_format(capsys):
+    glog.info("hello %s", "world")
+    err = capsys.readouterr().err
+    assert err.startswith("I")
+    assert "test_glog" in err and "hello world" in err
+
+
+def test_v_gate(capsys):
+    glog.V(1).info("hidden")
+    assert glog.V(0) and not glog.V(1)
+    assert capsys.readouterr().err == ""
+    glog.set_verbosity(2)
+    assert glog.V(2) and not glog.V(3)
+    glog.V(2).info("visible")
+    assert "visible" in capsys.readouterr().err
+
+
+def test_vmodule_overrides_global(capsys):
+    glog.set_verbosity(0)
+    glog.set_vmodule("test_glog=3,other*=1")
+    assert glog.V(3)
+    glog.V(3).info("module-gated")
+    assert "module-gated" in capsys.readouterr().err
+    glog.set_vmodule("somethingelse=5")
+    assert not glog.V(1)
+
+
+def test_vmodule_rejects_bad_spec():
+    with pytest.raises(ValueError):
+        glog.set_vmodule("nolevel")
+    with pytest.raises(ValueError):
+        glog.set_vmodule("mod=-1")
+
+
+def test_file_output_and_threshold(tmp_path, capsys):
+    glog.set_output(to_stderr=False, log_dir=str(tmp_path),
+                    stderr_threshold="ERROR")
+    glog.info("to file only")
+    glog.error("to file and stderr")
+    glog.flush()
+    err = capsys.readouterr().err
+    assert "to file only" not in err
+    assert "to file and stderr" in err
+    files = list(tmp_path.iterdir())
+    assert len(files) == 1
+    content = files[0].read_text()
+    assert "to file only" in content and "to file and stderr" in content
+
+
+def test_exception_includes_traceback(capsys):
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError:
+        glog.exception("op %s failed", "x")
+    err = capsys.readouterr().err
+    assert "op x failed" in err and "RuntimeError: boom" in err
+
+
+def test_flags_roundtrip(tmp_path):
+    import argparse
+
+    p = argparse.ArgumentParser()
+    glog.add_flags(p)
+    args = p.parse_args(["-v", "2", "-vmodule", "foo=4",
+                         "-logdir", str(tmp_path)])
+    glog.init_from_flags(args)
+    assert glog._state.verbosity == 2
+    assert glog._state.vmodule == [("foo", 4)]
+    assert glog._state.log_dir == str(tmp_path)
+    assert glog._state.to_stderr is False  # -logdir without -logtostderr
