@@ -123,6 +123,14 @@ class Master:
                 self._notify(m["id"], dn, deleted=True)
         if "ec_shards" in hb:
             self.topo.sync_data_node_ec_shards(dn, hb["ec_shards"])
+        # instant EC-shard deltas (master_grpc_server.go:83-98 incremental
+        # branch): register/unregister only the changed shard bits
+        for m in hb.get("new_ec_shards", []):
+            self.topo.register_ec_shards(m["id"], dn, m.get("ec_index_bits", 0))
+        for m in hb.get("deleted_ec_shards", []):
+            self.topo.unregister_ec_shards(
+                m["id"], dn, m.get("ec_index_bits", ~0)
+            )
         return {"volume_size_limit": self.topo.volume_size_limit}
 
     def handle_node_disconnect(self, dn: DataNode) -> None:

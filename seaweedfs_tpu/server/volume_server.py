@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Optional
 
 from ..ec import encoder
@@ -40,6 +41,7 @@ from ..storage.needle import (
 )
 from ..storage.store import Store
 from ..storage.volume import DeletedError, NotFoundError, volume_file_name
+from ..util import glog
 from .http_util import JsonHandler, http_bytes, http_json, start_server
 
 
@@ -463,6 +465,10 @@ class VolumeServer:
         vid = int(q["volume"])
         collection = q.get("collection", "")
         ext = q["ext"]
+        if ext in (".dat", ".idx"):
+            v = self.store.find_volume(vid)
+            if v is not None:
+                v.sync()  # flush buffered appends so the copy is complete
         for loc in self.store.locations:
             p = volume_file_name(loc.directory, collection, vid) + ext
             if os.path.exists(p):
@@ -490,13 +496,13 @@ class VolumeServer:
             with open(base + ext, "wb") as f:
                 f.write(data)
         loc.load_existing_volumes()
-        if self.store.find_volume(vid) is None:
+        v = self.store.find_volume(vid)
+        if v is None:
             return 500, {"error": "volume copied but failed to load"}
-        self.store.new_volumes.append(vid)
-        try:
-            self._heartbeat_once()
-        except Exception:
-            pass
+        # instant delta beat (volume_grpc_client_to_master.go:155): the
+        # heartbeat loop wakes on delta_event and reports the new volume
+        # without waiting out the pulse
+        self.store.queue_new_volume(v)
         return 200, {}
 
     def _h_ec_mount(self, h, path, q, body):
@@ -507,12 +513,22 @@ class VolumeServer:
         if ev is None:
             return 404, {"error": f"no local shards for {vid}"}
         ev.refresh_shards()
-        return 200, {"shards": ev.shard_ids()}
+        sids = ev.shard_ids()
+        self.store.queue_new_ec_shards(
+            vid, ev.collection, sum(1 << s for s in sids)
+        )
+        return 200, {"shards": sids}
 
     def _h_ec_unmount(self, h, path, q, body):
         vid = int(q["volume"])
+        ev = self.store.find_ec_volume(vid)
+        bits = sum(1 << s for s in ev.shard_ids()) if ev else 0
         for loc in self.store.locations:
             loc.unload_ec_volume(vid)
+        if bits:
+            self.store.queue_deleted_ec_shards(
+                vid, ev.collection if ev else "", bits
+            )
         return 200, {}
 
     def _h_ec_delete_shards(self, h, path, q, body):
@@ -527,13 +543,19 @@ class VolumeServer:
                     removed.append(sid)
                 except FileNotFoundError:
                     pass
+        collection = ""
         for loc in self.store.locations:
             ev = loc.find_ec_volume(vid)
             if ev:
+                collection = ev.collection
                 for sid in shard_ids:
                     shard = ev.shards.pop(sid, None)
                     if shard:
                         shard.close()
+        if removed:
+            self.store.queue_deleted_ec_shards(
+                vid, collection, sum(1 << s for s in removed)
+            )
         return 200, {"removed": removed}
 
     def _h_ec_shard_read(self, h, path, q, body):
@@ -554,14 +576,7 @@ class VolumeServer:
         return 200, hb
 
     # -- heartbeat loop (volume_grpc_client_to_master.go:50) -----------------
-    def _heartbeat_once(self) -> None:
-        hb = self.store.collect_heartbeat()
-        hb["ec_shards"] = self.store.collect_ec_heartbeat()["ec_shards"]
-        # full beats supersede the delta queues (the reference's Store delta
-        # channels feed instant beats between pulses); drain so they don't
-        # grow unboundedly — instant delta beats are a future optimization
-        self.store.new_volumes.clear()
-        self.store.deleted_volumes.clear()
+    def _send_beat(self, hb: dict) -> None:
         hb["data_center"] = self.data_center
         hb["rack"] = self.rack
         hb["max_volume_count"] = self.max_volume_count
@@ -572,15 +587,63 @@ class VolumeServer:
         # to the new leader on the master's say-so)
         leader = ack.get("leader")
         if leader and leader != self.master_url:
+            glog.info("following new master leader %s", leader)
             self.master_url = leader
 
+    def _heartbeat_once(self) -> None:
+        # drain BEFORE collecting: a delta queued mid-collection then stays
+        # queued and fires as its own beat; the other order would swallow a
+        # delta for a volume created after the snapshot
+        self.store.drain_deltas()
+        hb = self.store.collect_heartbeat()
+        hb["ec_shards"] = self.store.collect_ec_heartbeat()["ec_shards"]
+        self._send_beat(hb)
+
+    def _delta_beat_once(self) -> None:
+        """Instant delta beat: only the queued new/deleted volume + EC-shard
+        messages (volume_grpc_client_to_master.go:155-197 select arms)."""
+        deltas = self.store.drain_deltas()
+        if not deltas:
+            return
+        hb = {"ip": self.host, "port": self.port,
+              "public_url": self.store.public_url}
+        hb.update(deltas)
+        self._send_beat(hb)
+
     def _hb_loop(self):
-        while not self._stop.wait(self.pulse_seconds):
+        next_full = time.monotonic() + self.pulse_seconds
+        while not self._stop.is_set():
+            remaining = max(0.0, next_full - time.monotonic())
+            fired = self.store.delta_event.wait(min(remaining, 2.0))
+            if self._stop.is_set():
+                break
             try:
-                self._heartbeat_once()
-            except Exception:
-                # current master unreachable: try the next seed
+                if fired:
+                    self._delta_beat_once()
+                elif time.monotonic() >= next_full:
+                    self._heartbeat_once()
+                    next_full = time.monotonic() + self.pulse_seconds
+                else:
+                    # idle liveness probe: the reference's bidi stream
+                    # breaks the instant its master dies; an HTTP pulse
+                    # must probe actively or a long pulse would hide a
+                    # master failover for up to pulse_seconds
+                    r = http_json(
+                        "GET",
+                        f"http://{self.master_url}/cluster/ping",
+                        timeout=2.0,
+                    )
+                    if not r.get("ok"):
+                        raise RuntimeError(f"ping: {r}")
+            except Exception as e:
+                # current master unreachable: rotate to the next seed and
+                # re-register PROMPTLY with a full beat (the reference's
+                # heartbeat loop redials seed masters in a tight retry,
+                # volume_grpc_client_to_master.go:50-95)
+                glog.V(1).info("heartbeat to %s failed (%s); rotating",
+                               self.master_url, e)
                 self._rotate_master()
+                next_full = time.monotonic() + min(1.0, self.pulse_seconds)
 
     def _rotate_master(self) -> None:
         if len(self.master_seeds) <= 1:
@@ -626,17 +689,23 @@ class VolumeServer:
             ]
 
         self._srv = start_server(Handler, self.host, self.port)
+        glog.info("volume server up on %s:%d (%d volumes) → master %s",
+                  self.host, self.port,
+                  sum(len(l.volumes) for l in self.store.locations),
+                  self.master_url)
         try:
             self._heartbeat_once()
         except Exception:
-            pass
+            glog.warning("initial heartbeat to %s failed", self.master_url)
         self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
         self._hb_thread.start()
         return self
 
     def stop(self):
         self._stop.set()
+        self.store.delta_event.set()  # wake the heartbeat loop to exit
         if self._srv:
             self._srv.shutdown()
             self._srv.server_close()
         self.store.close()
+        glog.info("volume server %s:%d stopped", self.host, self.port)

@@ -49,11 +49,17 @@ class Store:
         self._ec_codec: Optional[Codec] = None
         self._ec_backend = ec_backend
         self.remote_shard_reader: Optional[RemoteShardReader] = None
-        # delta queues consumed by the heartbeat loop (store.go:33-50)
-        self.new_volumes: deque[int] = deque()
-        self.deleted_volumes: deque[int] = deque()
-        self.new_ec_shards: deque[tuple[int, int]] = deque()
-        self.deleted_ec_shards: deque[tuple[int, int]] = deque()
+        # delta queues consumed by the heartbeat loop (store.go:33-50 —
+        # NewVolumesChan etc.); entries are heartbeat message dicts so the
+        # master can apply them without a full sync. delta_event wakes the
+        # heartbeat loop for an instant delta beat, the analog of the
+        # reference's select over the Store channels
+        # (volume_grpc_client_to_master.go:155-197).
+        self.new_volumes: deque[dict] = deque()
+        self.deleted_volumes: deque[dict] = deque()
+        self.new_ec_shards: deque[dict] = deque()
+        self.deleted_ec_shards: deque[dict] = deque()
+        self.delta_event = threading.Event()
         self._lock = threading.RLock()
 
     @property
@@ -80,7 +86,7 @@ class Store:
         loc = self._pick_location()
         v = Volume(loc.directory, collection, vid, replica_placement, ttl)
         loc.add_volume(v)
-        self.new_volumes.append(vid)
+        self.queue_new_volume(v)
         return v
 
     def _pick_location(self) -> DiskLocation:
@@ -104,11 +110,53 @@ class Store:
         return self.find_volume(vid) is not None
 
     def delete_volume(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        msg = self._volume_message(v) if v is not None else {"id": vid}
         for loc in self.locations:
             if loc.delete_volume(vid):
-                self.deleted_volumes.append(vid)
+                with self._lock:
+                    self.deleted_volumes.append(msg)
+                self.delta_event.set()
                 return True
         return False
+
+    # -- delta beat plumbing -------------------------------------------------
+    def queue_new_volume(self, v: Volume) -> None:
+        with self._lock:
+            self.new_volumes.append(self._volume_message(v))
+        self.delta_event.set()
+
+    def queue_new_ec_shards(self, vid: int, collection: str, bits: int) -> None:
+        with self._lock:
+            self.new_ec_shards.append(
+                {"id": vid, "collection": collection, "ec_index_bits": bits}
+            )
+        self.delta_event.set()
+
+    def queue_deleted_ec_shards(
+        self, vid: int, collection: str, bits: int
+    ) -> None:
+        with self._lock:
+            self.deleted_ec_shards.append(
+                {"id": vid, "collection": collection, "ec_index_bits": bits}
+            )
+        self.delta_event.set()
+
+    def drain_deltas(self) -> dict:
+        """Pop all queued delta messages; empty dict when nothing pending."""
+        with self._lock:
+            out = {}
+            for key, q in (
+                ("new_volumes", self.new_volumes),
+                ("deleted_volumes", self.deleted_volumes),
+                ("new_ec_shards", self.new_ec_shards),
+                ("deleted_ec_shards", self.deleted_ec_shards),
+            ):
+                if q:
+                    out[key] = list(q)
+                    q.clear()
+            self.delta_event.clear()
+            return out
 
     def mark_volume_readonly(self, vid: int) -> bool:
         v = self.find_volume(vid)
@@ -207,27 +255,29 @@ class Store:
         return rebuilt[missing_shard].tobytes()
 
     # -- heartbeat (store.go:204-297) ----------------------------------------
+    @staticmethod
+    def _volume_message(v: Volume) -> dict:
+        return {
+            "id": v.id,
+            "size": v.size(),
+            "collection": v.collection,
+            "file_count": v.file_count(),
+            "delete_count": v.deleted_count(),
+            "deleted_byte_count": v.deleted_size(),
+            "read_only": v.read_only,
+            "replica_placement": v.super_block.replica_placement.to_byte(),
+            "version": v.version,
+            "ttl": v.ttl.to_uint32(),
+            "compact_revision": v.super_block.compaction_revision,
+        }
+
     def collect_heartbeat(self) -> dict:
         volumes = []
         max_file_key = 0
         for loc in self.locations:
             for v in loc.volumes.values():
                 max_file_key = max(max_file_key, v.max_file_key())
-                volumes.append(
-                    {
-                        "id": v.id,
-                        "size": v.size(),
-                        "collection": v.collection,
-                        "file_count": v.file_count(),
-                        "delete_count": v.deleted_count(),
-                        "deleted_byte_count": v.deleted_size(),
-                        "read_only": v.read_only,
-                        "replica_placement": v.super_block.replica_placement.to_byte(),
-                        "version": v.version,
-                        "ttl": v.ttl.to_uint32(),
-                        "compact_revision": v.super_block.compaction_revision,
-                    }
-                )
+                volumes.append(self._volume_message(v))
         return {
             "ip": self.ip,
             "port": self.port,

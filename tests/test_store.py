@@ -46,7 +46,12 @@ def test_store_volume_crud(tmp_path):
     hb = store.collect_heartbeat()
     assert len(hb["volumes"]) == 2
     assert hb["volumes"][0]["file_count"] + hb["volumes"][1]["file_count"] == 1
-    assert list(store.new_volumes) == [1, 2]
+    # delta queue holds heartbeat-shaped messages for instant delta beats
+    assert [m["id"] for m in store.new_volumes] == [1, 2]
+    assert store.delta_event.is_set()
+    deltas = store.drain_deltas()
+    assert [m["id"] for m in deltas["new_volumes"]] == [1, 2]
+    assert not store.delta_event.is_set() and not store.new_volumes
 
     assert store.delete_volume(2)
     assert not store.has_volume(2)
