@@ -31,6 +31,7 @@ def cmd_master(args):
         volume_size_limit_mb=args.volume_size_limit_mb,
         default_replication=args.default_replication,
         peers=peers or None,
+        meta_dir=args.mdir or None,
     ).start()
     print(f"master listening on {ms.url}")
     _wait_forever()
@@ -382,7 +383,10 @@ def _wait_forever():
 
 
 def main(argv=None):
+    from .util import glog
+
     p = argparse.ArgumentParser(prog="seaweedfs_tpu")
+    glog.add_flags(p)  # global flags, before the subcommand (as in weed)
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master", help="run a master server")
@@ -390,6 +394,8 @@ def main(argv=None):
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volumeSizeLimitMB", dest="volume_size_limit_mb", type=int, default=30 * 1024)
     m.add_argument("-defaultReplication", dest="default_replication", default="000")
+    m.add_argument("-mdir", default="",
+                   help="dir for durable election/sequence state (weed master -mdir)")
     m.add_argument(
         "-peers",
         default="",
@@ -555,6 +561,7 @@ def main(argv=None):
     ver.set_defaults(fn=cmd_version)
 
     args = p.parse_args(argv)
+    glog.init_from_flags(args)
     args.fn(args)
 
 

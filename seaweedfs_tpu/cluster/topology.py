@@ -396,6 +396,12 @@ class Topology(Node):
                 if nodes
             }
 
+    def checkpoint_max_volume_id(self, vid: int) -> None:
+        """Follower-side: adopt the leader's volume-id high-water mark so a
+        failover never re-allocates a vid (rides leader beats)."""
+        with self._lock:
+            self.max_volume_id = max(self.max_volume_id, vid)
+
     def next_volume_id(self) -> int:
         with self._lock:
             self.max_volume_id += 1

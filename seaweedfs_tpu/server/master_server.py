@@ -21,6 +21,7 @@ from typing import Optional
 
 from ..cluster.master import Master
 from ..cluster.topology import DataNode
+from ..util import glog
 from .http_util import JsonHandler, http_json, start_server
 
 
@@ -37,6 +38,7 @@ class MasterServer:
         jwt_expires_seconds: int = 10,
         peers: Optional[list[str]] = None,
         lease_seconds: float = 3.0,
+        meta_dir: Optional[str] = None,
     ):
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
@@ -62,12 +64,29 @@ class MasterServer:
         # failover — the new leader starts past the margin (gaps in needle
         # ids are harmless).
         seq_margin = 1_000_000
+        # vid margin covers grows in the ≤lease/3 window between beats the
+        # same way seq_margin covers needle-id batches: a failed-over
+        # leader skips past anything the old leader might have allocated
+        # unreplicated (vids are plentiful; gaps are harmless)
+        vid_margin = 64
+        state_path = None
+        if meta_dir:
+            import os as _os
+
+            _os.makedirs(meta_dir, exist_ok=True)
+            state_path = _os.path.join(meta_dir, f"election_{port}.json")
         self.election = LeaderElection(
             f"{host}:{port}",
             peers or [f"{host}:{port}"],
             lease_seconds=lease_seconds,
             get_max_file_key=lambda: self.master.sequencer.peek() + seq_margin,
             on_checkpoint=self.master.sequencer.set_max,
+            # volume-id counter rides the beats too (ADVICE: two leaders
+            # independently allocating the same next_volume_id): a new
+            # leader continues past the old one's high-water mark
+            get_max_volume_id=lambda: self.master.topo.max_volume_id + vid_margin,
+            on_volume_id_checkpoint=self.master.topo.checkpoint_max_volume_id,
+            state_path=state_path,
         )
 
     # -- volume allocation via volume server admin endpoint ------------------
@@ -235,7 +254,22 @@ class MasterServer:
 
         b = json.loads(body)
         return 200, self.election.receive_beat(
-            b["leader"], b["term"], b.get("max_file_key", 0)
+            b["leader"],
+            b["term"],
+            b.get("max_file_key", 0),
+            b.get("max_volume_id", 0),
+        )
+
+    def _h_vote(self, h, path, q, body):
+        import json
+
+        b = json.loads(body)
+        return 200, self.election.receive_vote_request(
+            b["candidate"],
+            b["term"],
+            b.get("max_file_key", 0),
+            b.get("max_volume_id", 0),
+            b.get("prevote", False),
         )
 
     def _h_lock(self, h, path, q, body):
@@ -298,11 +332,14 @@ class MasterServer:
                 ("POST", "/cluster/heartbeat", ms._h_heartbeat),
                 ("GET", "/cluster/ping", ms._h_ping),
                 ("POST", "/cluster/leader_beat", ms._h_leader_beat),
+                ("POST", "/cluster/vote", ms._h_vote),
                 ("GET", "/dir/status", ms._h_status),
                 ("GET", "/cluster/status", ms._h_status),
             ]
 
         self._srv = start_server(Handler, self.host, self.port)
+        glog.info("master up on %s:%d (peers: %s)", self.host, self.port,
+                  ",".join(self.election.peers) or "none")
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
         self.election.start()
@@ -314,6 +351,7 @@ class MasterServer:
         if self._srv:
             self._srv.shutdown()
             self._srv.server_close()
+        glog.info("master %s:%d stopped", self.host, self.port)
 
     @property
     def url(self) -> str:

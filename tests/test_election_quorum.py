@@ -1,0 +1,253 @@
+"""Quorum election safety: partitions cannot produce two serving leaders.
+
+The reference gets this from raft (`weed/server/raft_server.go:21-54`);
+this build's election must hold the same invariant: a leader serves assigns
+only while it holds a majority of the configured peer set, so two sides of
+a partition can never both report `is_leader`.
+
+These tests drive LeaderElection instances over a simulated network (the
+`_rpc` hook) so partitions are deterministic and instant.
+"""
+
+import threading
+import time
+
+from seaweedfs_tpu.cluster.election import LeaderElection
+
+
+class SimNet:
+    """In-process message router with a configurable partition."""
+
+    def __init__(self):
+        self.nodes: dict[str, LeaderElection] = {}
+        self.groups: list[set[str]] | None = None  # None = fully connected
+        self.lock = threading.Lock()
+
+    def reachable(self, a: str, b: str) -> bool:
+        with self.lock:
+            if self.groups is None:
+                return True
+            return any(a in g and b in g for g in self.groups)
+
+    def partition(self, *groups):
+        with self.lock:
+            self.groups = [set(g) for g in groups]
+
+    def heal(self):
+        with self.lock:
+            self.groups = None
+
+    def rpc(self, src: str, peer: str, path: str, body: dict) -> dict:
+        if not self.reachable(src, peer):
+            raise ConnectionError(f"partitioned: {src} -/-> {peer}")
+        node = self.nodes[peer]
+        if path == "/cluster/leader_beat":
+            return node.receive_beat(
+                body["leader"], body["term"],
+                body.get("max_file_key", 0), body.get("max_volume_id", 0),
+            )
+        if path == "/cluster/vote":
+            return node.receive_vote_request(
+                body["candidate"], body["term"],
+                body.get("max_file_key", 0), body.get("max_volume_id", 0),
+                body.get("prevote", False),
+            )
+        raise ValueError(path)
+
+
+def make_cluster(net: SimNet, n: int = 3, lease: float = 0.4):
+    urls = [f"m{i}:9333" for i in range(n)]
+    nodes = []
+    for u in urls:
+        e = LeaderElection(u, urls, lease_seconds=lease)
+        e._rpc = lambda peer, path, body, _u=u: net.rpc(_u, peer, path, body)
+        net.nodes[u] = e
+        nodes.append(e)
+    for e in nodes:
+        e.start()
+    return urls, nodes
+
+
+def wait_for(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.02)
+    return None
+
+
+def leaders(nodes):
+    return [e for e in nodes if e.is_leader]
+
+
+def stop_all(nodes):
+    for e in nodes:
+        e.stop()
+
+
+def test_converges_to_single_leader():
+    net = SimNet()
+    urls, nodes = make_cluster(net)
+    try:
+        assert wait_for(lambda: len(leaders(nodes)) == 1)
+        lead = leaders(nodes)[0]
+        # everyone agrees
+        assert wait_for(
+            lambda: all(e.leader == lead.self_url for e in nodes)
+        )
+    finally:
+        stop_all(nodes)
+
+
+def test_minority_partitioned_leader_steps_down():
+    net = SimNet()
+    urls, nodes = make_cluster(net)
+    try:
+        assert wait_for(lambda: len(leaders(nodes)) == 1)
+        old = leaders(nodes)[0]
+        others = [u for u in urls if u != old.self_url]
+        # isolate the leader
+        net.partition({old.self_url}, set(others))
+        # the old leader loses quorum and stops claiming leadership
+        assert wait_for(lambda: not old.is_leader, timeout=5.0)
+        # the majority side elects a replacement
+        assert wait_for(
+            lambda: any(
+                e.is_leader for e in nodes if e.self_url != old.self_url
+            ),
+            timeout=5.0,
+        )
+        # INVARIANT: never two serving leaders — sample aggressively
+        for _ in range(50):
+            assert len(leaders(nodes)) <= 1
+            time.sleep(0.01)
+    finally:
+        stop_all(nodes)
+
+
+def test_heal_converges_without_dual_leader():
+    net = SimNet()
+    urls, nodes = make_cluster(net)
+    try:
+        assert wait_for(lambda: len(leaders(nodes)) == 1)
+        old = leaders(nodes)[0]
+        others = [u for u in urls if u != old.self_url]
+        net.partition({old.self_url}, set(others))
+        assert wait_for(
+            lambda: not old.is_leader
+            and any(e.is_leader for e in nodes if e is not old),
+            timeout=5.0,
+        )
+        net.heal()
+        # converge back to exactly one leader everyone agrees on
+        def settled():
+            ls = leaders(nodes)
+            return (
+                len(ls) == 1
+                and all(e.leader == ls[0].self_url for e in nodes)
+            )
+        assert wait_for(settled, timeout=5.0)
+        for _ in range(50):
+            assert len(leaders(nodes)) <= 1
+            time.sleep(0.01)
+    finally:
+        stop_all(nodes)
+
+
+def test_no_quorum_no_leader():
+    """2 of 3 nodes dead: the survivor must refuse to lead."""
+    net = SimNet()
+    urls, nodes = make_cluster(net)
+    try:
+        assert wait_for(lambda: len(leaders(nodes)) == 1)
+        survivor = nodes[2]
+        net.partition({survivor.self_url}, {urls[0]}, {urls[1]})
+        nodes[0].stop()
+        nodes[1].stop()
+        time.sleep(survivor.lease_seconds * 4)
+        assert not survivor.is_leader
+    finally:
+        stop_all(nodes)
+
+
+def test_one_vote_per_term():
+    e = LeaderElection("m0:9333", ["m0:9333", "m1:9333", "m2:9333"],
+                       lease_seconds=0.4)
+    # lease must be expired for votes to be grantable
+    e._last_beat = time.time() - 10
+    r1 = e.receive_vote_request("m1:9333", 5, 100)
+    assert r1["granted"]
+    r2 = e.receive_vote_request("m2:9333", 5, 100)
+    assert not r2["granted"]  # already voted for m1 in term 5
+    r3 = e.receive_vote_request("m2:9333", 6, 100)
+    assert r3["granted"]  # new term, new vote
+
+
+def test_stale_candidate_denied():
+    """A candidate behind on the sequence checkpoint cannot win."""
+    e = LeaderElection(
+        "m0:9333", ["m0:9333", "m1:9333", "m2:9333"],
+        lease_seconds=0.4, get_max_file_key=lambda: 1000,
+    )
+    e._last_beat = time.time() - 10
+    r = e.receive_vote_request("m1:9333", 3, 500)
+    assert not r["granted"]
+    r = e.receive_vote_request("m1:9333", 4, 2000)
+    assert r["granted"]
+
+
+def test_stale_volume_id_candidate_denied():
+    """A candidate behind on the volume-id counter cannot win either
+    (ADVICE: two leaders allocating the same next_volume_id)."""
+    e = LeaderElection(
+        "m0:9333", ["m0:9333", "m1:9333", "m2:9333"],
+        lease_seconds=0.4, get_max_volume_id=lambda: 50,
+    )
+    e._last_beat = time.time() - 10
+    assert not e.receive_vote_request("m1:9333", 3, 0, max_volume_id=10)["granted"]
+    assert e.receive_vote_request("m1:9333", 4, 0, max_volume_id=50)["granted"]
+
+
+def test_restart_cannot_double_vote(tmp_path):
+    """Persisted (term, voted_for): a bounced master refuses to vote for a
+    second candidate in the same term."""
+    path = str(tmp_path / "el.json")
+    peers = ["m0:9333", "m1:9333", "m2:9333"]
+    e = LeaderElection("m0:9333", peers, lease_seconds=0.4, state_path=path)
+    e._last_beat = time.time() - 10
+    assert e.receive_vote_request("m1:9333", 7, 0)["granted"]
+    # restart: state reloads from disk
+    e2 = LeaderElection("m0:9333", peers, lease_seconds=0.4, state_path=path)
+    e2._last_beat = time.time() - 10
+    assert e2.term == 7 and e2.voted_for == "m1:9333"
+    assert not e2.receive_vote_request("m2:9333", 7, 0)["granted"]
+    # same candidate may re-request its own vote
+    assert e2.receive_vote_request("m1:9333", 7, 0)["granted"]
+
+
+def test_prevote_does_not_inflate_terms():
+    """A flapping node campaigning against a healthy leader must not move
+    the cluster term: its pre-vote is denied WITHOUT state change, so on
+    heal the leader's beats are still accepted (no step-down)."""
+    net = SimNet()
+    urls, nodes = make_cluster(net)
+    try:
+        assert wait_for(lambda: len(leaders(nodes)) == 1)
+        lead = leaders(nodes)[0]
+        term_before = lead.term
+        flapper = next(e for e in nodes if e is not lead)
+        # isolate the flapper long enough for several failed campaigns
+        net.partition({flapper.self_url},
+                      {u for u in urls if u != flapper.self_url})
+        time.sleep(flapper.lease_seconds * 6)
+        net.heal()
+        # pre-vote kept the flapper's term at the cluster term: the leader
+        # is not deposed and the term did not move
+        time.sleep(lead.lease_seconds * 2)
+        assert lead.is_leader
+        assert lead.term == term_before
+        assert flapper.leader == lead.self_url
+    finally:
+        stop_all(nodes)
