@@ -540,52 +540,140 @@ class Volume:
 
     # -- vacuum / compaction (volume_vacuum.go) ------------------------------
     def compact(self) -> None:
-        """Rewrite live needles to .cpd/.cpx, then commit by rename.
+        """Concurrent compaction: snapshot-scan live needles to .cpd/.cpx
+        WITHOUT the write lock, then take the lock only to replay the delta
+        and swap files — the reference's `Compact2` + `makeupDiff`
+        (`volume_vacuum.go:66,181`). Writes and deletes keep landing during
+        the bulk copy; the commit replays every .idx entry appended after
+        the snapshot point (puts copy the new needle bytes, tombstones
+        re-delete), so no update is lost.
 
-        The whole operation holds the volume lock (the reference overlaps
-        compaction with writes and replays the delta in makeupDiff; the
-        lock-held variant trades write availability for simplicity —
-        equivalent end state).
+        Safe because both logs are append-only: bytes below the snapshot
+        sizes are immutable, so the unlocked scan reads a consistent
+        point-in-time state.
         """
         from . import idx as idx_mod
+        from .types import needle_map_entry_size
 
         with self._lock:
             if self._is_compacting:
                 raise VolumeError(f"volume {self.id} is already compacting")
             self._is_compacting = True
+        base = self.file_name()
+        entry_size = needle_map_entry_size(self.offset_size)
+        version = self.version
         try:
-            base = self.file_name()
+            with self._lock:
+                self.sync()
+                snap_dat = self.data_backend.size()
+                snap_idx = self.nm.index_file_size()
             new_sb = SuperBlock(
-                version=self.version,
+                version=version,
                 replica_placement=self.super_block.replica_placement,
                 ttl=self.super_block.ttl,
                 compaction_revision=(self.super_block.compaction_revision + 1)
                 & 0xFFFF,
                 extra=self.super_block.extra,
             )
-            with self._lock:
-                with open(base + ".cpd", "wb") as dst, open(
-                    base + ".cpx", "wb"
-                ) as dst_idx:
-                    dst.write(new_sb.to_bytes())
-                    new_offset = new_sb.block_size()
-                    for n, offset, total in self.scan_needles():
-                        if n.size <= 0:
-                            continue
-                        nv = self.nm.get(n.id)
-                        if nv is None or nv.offset != offset or not size_is_valid(nv.size):
-                            continue  # shadowed or deleted
-                        blob = self.data_backend.read_at(offset, total)
-                        dst.write(blob)
+            # phase 1 (no lock): live map as of the snapshot, from the
+            # immutable .idx prefix
+            live: dict[int, tuple[int, int]] = {}
+            with open(base + ".idx", "rb") as f:
+                prefix = f.read(snap_idx)
+            for i in range(0, len(prefix) - entry_size + 1, entry_size):
+                key, off, size = idx_mod.unpack_entry(
+                    prefix[i : i + entry_size], self.offset_size
+                )
+                if size_is_valid(size):
+                    live[key] = (off, size)
+                else:
+                    live.pop(key, None)
+            # phase 2 (no lock): copy live needles in .dat order up to the
+            # snapshot size
+            with open(base + ".cpd", "wb") as dst, open(
+                base + ".cpx", "wb"
+            ) as dst_idx:
+                dst.write(new_sb.to_bytes())
+                new_offset = new_sb.block_size()
+                offset = self.super_block.block_size()
+                while offset + NEEDLE_HEADER_SIZE <= snap_dat:
+                    hdr = self.data_backend.read_at(offset, NEEDLE_HEADER_SIZE)
+                    if len(hdr) < NEEDLE_HEADER_SIZE:
+                        break
+                    _, nid, nsize = parse_needle_header(hdr)
+                    body_len = needle_body_length(
+                        nsize if nsize > 0 else 0, version
+                    )
+                    total = NEEDLE_HEADER_SIZE + body_len
+                    if offset + total > snap_dat:
+                        break
+                    lv = live.get(nid)
+                    if (
+                        lv is not None
+                        and lv[0] == offset
+                        and size_is_valid(lv[1])
+                    ):
+                        dst.write(self.data_backend.read_at(offset, total))
                         dst_idx.write(
                             idx_mod.pack_entry(
-                                n.id, new_offset, n.size, self.offset_size
+                                nid, new_offset, nsize, self.offset_size
                             )
                         )
                         new_offset += total
-                self._commit_compact(base)
+                    offset += total
+                # phase 3 (locked): makeupDiff — replay .idx entries
+                # appended during phases 1-2, then swap
+                with self._lock:
+                    self.sync()
+                    end_idx = self.nm.index_file_size()
+                    if end_idx > snap_idx:
+                        with open(base + ".idx", "rb") as f:
+                            f.seek(snap_idx)
+                            diff = f.read(end_idx - snap_idx)
+                        for i in range(
+                            0, len(diff) - entry_size + 1, entry_size
+                        ):
+                            key, off, size = idx_mod.unpack_entry(
+                                diff[i : i + entry_size], self.offset_size
+                            )
+                            if size_is_valid(size):
+                                total = NEEDLE_HEADER_SIZE + needle_body_length(
+                                    size, version
+                                )
+                                dst.write(self.data_backend.read_at(off, total))
+                                dst_idx.write(
+                                    idx_mod.pack_entry(
+                                        key, new_offset, size, self.offset_size
+                                    )
+                                )
+                                new_offset += total
+                            else:
+                                # copy the TOMBSTONE NEEDLE itself (it sits
+                                # at `off` in the old .dat) and point the
+                                # idx entry at its new offset — a 0-offset
+                                # tombstone would fail load-time integrity
+                                # verification and be truncated away,
+                                # resurrecting the delete
+                                total = NEEDLE_HEADER_SIZE + needle_body_length(
+                                    0, version
+                                )
+                                dst.write(self.data_backend.read_at(off, total))
+                                dst_idx.write(
+                                    idx_mod.pack_entry(
+                                        key, new_offset, size, self.offset_size
+                                    )
+                                )
+                                new_offset += total
+                    # close before the rename-swap; the outer `with` close
+                    # is then a no-op
+                    dst.close()
+                    dst_idx.close()
+                    self._commit_compact(base)
         finally:
             self._is_compacting = False
+
+    # Compact2 IS the compaction here; alias kept for reference parity
+    compact2 = compact
 
     def _commit_compact(self, base: str) -> None:
         self.data_backend.close()

@@ -1,0 +1,155 @@
+"""Concurrent vacuum: compaction must not lose writes that land mid-compact.
+
+The reference's `Compact2` scans a snapshot without the write lock and
+replays the concurrent delta in `makeupDiff` at commit
+(`weed/storage/volume_vacuum.go:66,181`). These tests drive real concurrent
+writers against `Volume.compact()` and assert zero lost updates.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import DeletedError, NotFoundError, Volume
+
+
+def fill(v, lo, hi, size=500):
+    rng = np.random.default_rng(lo)
+    for i in range(lo, hi):
+        v.write_needle(
+            Needle(cookie=0x77, id=i,
+                   data=rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        )
+
+
+def test_writes_during_compaction_survive(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    fill(v, 1, 201)
+    for i in range(1, 101):
+        v.delete_needle(Needle(id=i, cookie=0x77))
+
+    stop = threading.Event()
+    written = []
+    errors = []
+
+    def writer():
+        i = 1000
+        while not stop.is_set():
+            try:
+                v.write_needle(Needle(cookie=0x77, id=i, data=b"mid-compact %d" % i))
+                written.append(i)
+                i += 1
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=writer)
+    t.start()
+    time.sleep(0.02)  # let the writer get going
+    v.compact()
+    stop.set()
+    t.join()
+    assert not errors
+    assert len(written) > 0, "writer never ran during compaction"
+    # every pre-compact live needle still reads
+    for i in range(101, 201):
+        n = Needle(id=i)
+        v.read_needle(n)
+        assert len(n.data) == 500
+    # every deleted needle stays deleted
+    for i in range(1, 101):
+        with pytest.raises((DeletedError, NotFoundError)):
+            v.read_needle(Needle(id=i))
+    # every mid-compaction write survived the swap
+    for i in written:
+        n = Needle(id=i)
+        v.read_needle(n)
+        assert n.data == b"mid-compact %d" % i
+    v.close()
+    # and survives a reload from disk
+    v2 = Volume(str(tmp_path), "", 1, create_if_missing=False)
+    for i in written:
+        n = Needle(id=i)
+        v2.read_needle(n)
+        assert n.data == b"mid-compact %d" % i
+    v2.close()
+
+
+def test_deletes_and_overwrites_during_compaction(tmp_path):
+    """Tombstones and overwrites appended mid-compact must be replayed, not
+    resurrected from the snapshot."""
+    v = Volume(str(tmp_path), "", 2)
+    fill(v, 1, 301, size=2000)
+
+    seen_scan = threading.Event()
+    orig_read_at = v.data_backend.read_at
+    mutated = threading.Event()
+
+    def slow_read_at(offset, size):
+        # after the scan starts, inject mutations once from another thread's
+        # perspective: delete a snapshot-live needle and overwrite another
+        if seen_scan.is_set() and not mutated.is_set():
+            mutated.set()
+        return orig_read_at(offset, size)
+
+    v.data_backend.read_at = slow_read_at
+
+    result = {}
+
+    def compactor():
+        seen_scan.set()
+        v.compact()
+        result["done"] = True
+
+    t = threading.Thread(target=compactor)
+    t.start()
+    # race mutations against the scan; compact() replays whatever lands
+    # before its commit point
+    v.delete_needle(Needle(id=5, cookie=0x77))
+    v.write_needle(Needle(cookie=0x77, id=7, data=b"overwritten"))
+    t.join()
+    assert result.get("done")
+    with pytest.raises((DeletedError, NotFoundError)):
+        v.read_needle(Needle(id=5))
+    n = Needle(id=7)
+    v.read_needle(n)
+    assert n.data == b"overwritten"
+    v.close()
+    # the replayed tombstone must survive the load-time integrity check:
+    # a reload (which verifies/truncates the idx tail) must NOT resurrect
+    # the mid-compaction delete
+    v2 = Volume(str(tmp_path), "", 2, create_if_missing=False)
+    with pytest.raises((DeletedError, NotFoundError)):
+        v2.read_needle(Needle(id=5))
+    n = Needle(id=7)
+    v2.read_needle(n)
+    assert n.data == b"overwritten"
+    v2.close()
+
+
+def test_compact_rejects_reentry(tmp_path):
+    v = Volume(str(tmp_path), "", 3)
+    fill(v, 1, 11)
+    hold = threading.Event()
+    release = threading.Event()
+    orig = v.data_backend.read_at
+
+    def gated(offset, size):
+        hold.set()
+        release.wait(timeout=5)
+        return orig(offset, size)
+
+    v.data_backend.read_at = gated
+    t = threading.Thread(target=v.compact)
+    t.start()
+    assert hold.wait(timeout=5)
+    from seaweedfs_tpu.storage.volume import VolumeError
+
+    with pytest.raises(VolumeError):
+        v.compact()
+    release.set()
+    t.join()
+    v.close()
