@@ -50,6 +50,7 @@ def cmd_volume(args):
         rack=args.rack,
         max_volume_count=args.max,
         ec_backend=args.ec_backend or None,
+        needle_map_kind=args.index,
     ).start()
     print(f"volume server on {vs.host}:{vs.port} → master {args.mserver}")
     _wait_forever()
@@ -411,6 +412,9 @@ def main(argv=None):
     v.add_argument("-dataCenter", dest="data_center", default="DefaultDataCenter")
     v.add_argument("-rack", default="DefaultRack")
     v.add_argument("-max", type=int, default=7)
+    v.add_argument("-index", default="dense",
+                   choices=["memory", "dense", "sqlite", "sorted"],
+                   help="needle map kind (weed volume -index memory|leveldb)")
     v.add_argument("-ec.backend", dest="ec_backend", default="", choices=["", "tpu", "cpu", "numpy", "mesh"])
     v.set_defaults(fn=cmd_volume)
 

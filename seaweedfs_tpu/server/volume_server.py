@@ -58,6 +58,7 @@ class VolumeServer:
         max_volume_count: int = 7,
         pulse_seconds: float = 5.0,
         ec_backend: Optional[str] = None,
+        needle_map_kind: str = "dense",
         jwt_signing_key: str = "",
         jwt_read_key: str = "",
         whitelist: Optional[list[str]] = None,
@@ -89,6 +90,7 @@ class VolumeServer:
             port=port,
             public_url=public_url or f"{host}:{port}",
             ec_backend=ec_backend,
+            needle_map_kind=needle_map_kind,
         )
         self.store.remote_shard_reader = self._remote_shard_reader
         self._srv = None

@@ -32,11 +32,13 @@ class DiskLocation:
         directory: str,
         max_volume_count: int = 7,
         min_free_space_ratio: float = 0.01,
+        needle_map_kind: str = "dense",
     ):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_volume_count = max_volume_count
         self.min_free_space_ratio = min_free_space_ratio
+        self.needle_map_kind = needle_map_kind
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}
         self._lock = threading.RLock()
@@ -58,6 +60,7 @@ class DiskLocation:
                             self.volumes[vid] = Volume(
                                 self.directory, collection, vid,
                                 create_if_missing=False,
+                                needle_map_kind=self.needle_map_kind,
                             )
                     elif ext == ".ecx":
                         collection, vid = parse_volume_base_name(base)

@@ -47,6 +47,11 @@ class NeedleMapper:
     def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
         raise NotImplementedError
 
+    def release(self) -> None:
+        """Drop auxiliary resources (db handles, caches) WITHOUT closing the
+        shared .idx file — called before the owner swaps in a fresh map over
+        the same index handle. No-op for purely in-memory kinds."""
+
     def close(self) -> None:
         pass
 
@@ -54,20 +59,68 @@ class NeedleMapper:
         pass
 
 
-class CompactNeedleMap(NeedleMapper):
-    """In-memory map + .idx append log (NeedleMapInMemory kind)."""
+class IdxLogMixin:
+    """Shared .idx append log + mapMetric boilerplate for all map kinds.
 
-    def __init__(self, index_file: BinaryIO, offset_size: int = OFFSET_SIZE):
-        self._m: dict[int, tuple[int, int]] = {}
+    Subclass __init__ must set `_index_file`, `_offset_size`, and the five
+    counters (file_counter, file_byte_counter, deletion_counter,
+    deletion_byte_counter, max_file_key)."""
+
+    def _init_log(self, index_file: BinaryIO, offset_size: int) -> None:
         self._index_file = index_file
-        self._lock = threading.Lock()
         self._offset_size = offset_size
-        # mapMetric counters
         self.file_counter = 0
         self.file_byte_counter = 0
         self.deletion_counter = 0
         self.deletion_byte_counter = 0
         self.max_file_key = 0
+
+    def _append_entry(self, key: int, offset: int, size: int) -> None:
+        entry = idx_mod.pack_entry(key, offset, size, self._offset_size)
+        self._index_file.seek(0, io.SEEK_END)
+        self._index_file.write(entry)
+
+    def content_size(self) -> int:
+        return self.file_byte_counter
+
+    def deleted_size(self) -> int:
+        return self.deletion_byte_counter
+
+    def file_count(self) -> int:
+        return self.file_counter
+
+    def deleted_count(self) -> int:
+        return self.deletion_counter
+
+    def index_file_size(self) -> int:
+        try:
+            return os.fstat(self._index_file.fileno()).st_size
+        except (OSError, AttributeError, io.UnsupportedOperation):
+            self._index_file.seek(0, io.SEEK_END)
+            return self._index_file.tell()
+
+    def sync(self) -> None:
+        self._index_file.flush()
+        try:
+            os.fsync(self._index_file.fileno())
+        except (OSError, AttributeError, io.UnsupportedOperation):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._index_file.flush()
+        except ValueError:
+            pass
+        self._index_file.close()
+
+
+class CompactNeedleMap(IdxLogMixin, NeedleMapper):
+    """In-memory map + .idx append log (NeedleMapInMemory kind)."""
+
+    def __init__(self, index_file: BinaryIO, offset_size: int = OFFSET_SIZE):
+        self._m: dict[int, tuple[int, int]] = {}
+        self._lock = threading.Lock()
+        self._init_log(index_file, offset_size)
 
     # -- loading (needle_map_memory.go:30-51) --------------------------------
     @classmethod
@@ -95,11 +148,6 @@ class CompactNeedleMap(NeedleMapper):
                     nm._m[key] = (old[0], -old[1])
         index_file.seek(0, io.SEEK_END)
         return nm
-
-    def _append_entry(self, key: int, offset: int, size: int) -> None:
-        entry = idx_mod.pack_entry(key, offset, size, self._offset_size)
-        self._index_file.seek(0, io.SEEK_END)
-        self._index_file.write(entry)
 
     # -- mutations -----------------------------------------------------------
     def put(self, key: int, offset: int, size: int) -> None:
@@ -147,36 +195,3 @@ class CompactNeedleMap(NeedleMapper):
 
     def __len__(self) -> int:
         return len(self._m)
-
-    def content_size(self) -> int:
-        return self.file_byte_counter
-
-    def deleted_size(self) -> int:
-        return self.deletion_byte_counter
-
-    def file_count(self) -> int:
-        return self.file_counter
-
-    def deleted_count(self) -> int:
-        return self.deletion_counter
-
-    def index_file_size(self) -> int:
-        try:
-            return os.fstat(self._index_file.fileno()).st_size
-        except (OSError, AttributeError, io.UnsupportedOperation):
-            self._index_file.seek(0, io.SEEK_END)
-            return self._index_file.tell()
-
-    def sync(self) -> None:
-        self._index_file.flush()
-        try:
-            os.fsync(self._index_file.fileno())
-        except (OSError, AttributeError, io.UnsupportedOperation):
-            pass
-
-    def close(self) -> None:
-        try:
-            self._index_file.flush()
-        except ValueError:
-            pass
-        self._index_file.close()

@@ -1,0 +1,557 @@
+"""Memory-dense needle map kinds: 16 bytes/entry, plus on-disk spill.
+
+The Haystack point of the whole system is that a volume's needle index fits
+in RAM at 16 bytes per entry (`weed/storage/needle_map/compact_map.go:173`
+— sectioned sorted arrays + an overflow map; BASELINE.md "per-file RAM
+index entry: 16 bytes"). A Python dict costs ~100 bytes/entry, which is the
+wrong memory profile at millions of needles per volume.
+
+Kinds here (needle_map.go:12-19 analog):
+
+- ``DenseNeedleMap`` — NeedleMapInMemory with the reference's memory
+  profile: parallel numpy arrays (key u64 + scaled-offset u32 + size i32 =
+  16B exactly; the 5-byte-offset flavor adds a u8 high-byte plane, matching
+  the reference's `OffsetHigher` extra byte). Sorted base + small overflow
+  dict for recent inserts, merged in batches — the same sorted-base +
+  overflow shape as `compact_map.go`, with numpy `searchsorted` instead of
+  hand-rolled binary search. Loading a .idx is fully vectorized (no
+  per-entry Python objects), so a million-needle volume indexes in tens of
+  milliseconds and ~16MB.
+- ``SqliteNeedleMap`` — the LevelDB kind (`needle_map_leveldb.go:26`):
+  entries live in an on-disk B-tree beside the volume for indexes too big
+  for RAM; metric counters persist in a meta table so a clean load is O(1),
+  and a crash (meta out of date vs the .idx) triggers a vectorized replay.
+- ``SortedFileNeedleMap`` — the read-only kind
+  (`needle_map_sorted_file.go:19`): binary-searches a key-sorted index file
+  (.sdx) directly on disk, zero resident entries; for sealed volumes.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+from typing import BinaryIO, Callable, Iterator, Optional
+
+import numpy as np
+
+from . import idx as idx_mod
+from .needle_map import IdxLogMixin, NeedleMapper, NeedleValue
+from .types import (
+    NEEDLE_PADDING_SIZE,
+    OFFSET_SIZE,
+    TOMBSTONE_FILE_SIZE,
+    needle_map_entry_size,
+    size_is_valid,
+)
+
+# sqlite binds signed 64-bit ints only; needle keys are full u64, so keys
+# are stored bias-shifted by 2^63 — the shift is order-preserving, so
+# ORDER BY stays ascending-key
+_KEY_BIAS = 1 << 63
+
+
+def _parse_idx_arrays(
+    raw: bytes, offset_size: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized .idx parse → (keys u64, scaled offsets u64, sizes i64)."""
+    entry = needle_map_entry_size(offset_size)
+    n = len(raw) // entry
+    a = np.frombuffer(raw[: n * entry], dtype=np.uint8).reshape(n, entry)
+    keys = a[:, :8].copy().view(">u8").ravel().astype(np.uint64)
+    if offset_size == 4:
+        offs = a[:, 8:12].copy().view(">u4").ravel().astype(np.uint64)
+    else:
+        # 5-byte flavor: 4 low bytes big-endian + most-significant 5th byte
+        # (types.py offset encoding)
+        lo = a[:, 8:12].copy().view(">u4").ravel().astype(np.uint64)
+        hi = a[:, 12].astype(np.uint64)
+        offs = (hi << np.uint64(32)) | lo
+    sizes = (
+        a[:, 8 + offset_size : 8 + offset_size + 4]
+        .copy()
+        .view(">i4")
+        .ravel()
+        .astype(np.int64)
+    )
+    return keys, offs, sizes
+
+
+def replay_idx_vectorized(raw: bytes, offset_size: int):
+    """Replay a whole .idx history without per-entry Python.
+
+    Returns (metrics, final_keys u64 sorted, final_scaled_offs u64,
+    final_sizes i64) where metrics is a dict of the mapMetric counters with
+    CompactNeedleMap-identical semantics (needle_map_metric.go): every put
+    counts toward file_counter, overwrites and deletes of a live put count
+    toward the deletion counters, and a key whose last action is a
+    tombstone keeps its final put's offset with a negated size.
+    """
+    keys, offs, sizes = _parse_idx_arrays(raw, offset_size)
+    n = len(keys)
+    empty = np.empty(0, dtype=np.uint64)
+    metrics = dict(file_counter=0, file_byte_counter=0, deletion_counter=0,
+                   deletion_byte_counter=0, max_file_key=0)
+    if n == 0:
+        return metrics, empty, empty, np.empty(0, dtype=np.int64)
+    puts = (offs != 0) & (sizes > 0)
+    metrics["max_file_key"] = int(keys.max())
+    metrics["file_counter"] = int(puts.sum())
+    metrics["file_byte_counter"] = int(sizes[puts].sum())
+    # per-key sequences: stable sort groups each key's entries in append
+    # order, so "previous state was a live put" is a shift within the run
+    order = np.argsort(keys, kind="stable")
+    k_s, p_s, sz_s, off_s = keys[order], puts[order], sizes[order], offs[order]
+    same_prev = np.empty(n, dtype=bool)
+    same_prev[0] = False
+    same_prev[1:] = k_s[1:] == k_s[:-1]
+    prev_valid = np.empty(n, dtype=bool)
+    prev_valid[0] = False
+    prev_valid[1:] = p_s[:-1]
+    prev_valid &= same_prev
+    # a delete always counts; a put over a live put shadows it
+    metrics["deletion_counter"] = int((~p_s).sum() + (p_s & prev_valid).sum())
+    prev_size = np.empty(n, dtype=np.int64)
+    prev_size[0] = 0
+    prev_size[1:] = sz_s[:-1]
+    metrics["deletion_byte_counter"] = int(prev_size[prev_valid].sum())
+    # final state per key: last put wins; a later tombstone negates it
+    starts = np.nonzero(~same_prev)[0]
+    ends = np.concatenate([starts[1:], np.array([n])]) - 1
+    put_idx = np.where(p_s, np.arange(n), -1)
+    last_put = np.maximum.reduceat(put_idx, starts)
+    has_put = last_put >= 0
+    lp = last_put[has_put]
+    fsizes = sz_s[lp]
+    fsizes = np.where(ends[has_put] > lp, -fsizes, fsizes)
+    return metrics, k_s[starts[has_put]].copy(), off_s[lp].copy(), fsizes
+
+
+class DenseNeedleMap(IdxLogMixin, NeedleMapper):
+    """16B/entry packed in-memory kind (compact_map.go analog)."""
+
+    MERGE_THRESHOLD = 8192
+
+    def __init__(self, index_file: BinaryIO, offset_size: int = OFFSET_SIZE):
+        self._lock = threading.Lock()
+        self._init_log(index_file, offset_size)
+        self._keys = np.empty(0, dtype=np.uint64)  # sorted, unique
+        self._offs = np.empty(0, dtype=np.uint32)  # scaled (/8)
+        self._offs_hi = (
+            np.empty(0, dtype=np.uint8) if offset_size == 5 else None
+        )
+        self._sizes = np.empty(0, dtype=np.int32)
+        # overflow holds only keys NOT in the base (updates to base keys go
+        # in place), so lookups check it first and merge is a pure union
+        self._overflow: dict[int, tuple[int, int]] = {}
+
+    # -- loading (vectorized; no per-entry Python) ---------------------------
+    @classmethod
+    def load(
+        cls, index_file: BinaryIO, offset_size: int = OFFSET_SIZE
+    ) -> "DenseNeedleMap":
+        nm = cls(index_file, offset_size)
+        index_file.seek(0)
+        raw = index_file.read()
+        index_file.seek(0, io.SEEK_END)
+        metrics, fkeys, foffs, fsizes = replay_idx_vectorized(raw, offset_size)
+        nm.__dict__.update(metrics)
+        nm._keys = fkeys
+        nm._offs = foffs.astype(np.uint32)
+        if nm._offs_hi is not None:
+            nm._offs_hi = (foffs >> np.uint64(32)).astype(np.uint8)
+        nm._sizes = fsizes.astype(np.int32)
+        return nm
+
+    # -- internals -----------------------------------------------------------
+    def _base_find(self, key: int) -> Optional[int]:
+        i = int(np.searchsorted(self._keys, np.uint64(key)))
+        if i < len(self._keys) and int(self._keys[i]) == key:
+            return i
+        return None
+
+    def _base_value(self, i: int) -> tuple[int, int]:
+        scaled = int(self._offs[i])
+        if self._offs_hi is not None:
+            scaled |= int(self._offs_hi[i]) << 32
+        return scaled * NEEDLE_PADDING_SIZE, int(self._sizes[i])
+
+    def _base_set(self, i: int, offset: int, size: int) -> None:
+        scaled = offset // NEEDLE_PADDING_SIZE
+        self._offs[i] = scaled & 0xFFFFFFFF
+        if self._offs_hi is not None:
+            self._offs_hi[i] = scaled >> 32
+        self._sizes[i] = size
+
+    def _current(self, key: int) -> Optional[tuple[int, int]]:
+        v = self._overflow.get(key)
+        if v is not None:
+            return v
+        i = self._base_find(key)
+        return self._base_value(i) if i is not None else None
+
+    def _merge_overflow(self) -> None:
+        if not self._overflow:
+            return
+        ok = np.fromiter(self._overflow.keys(), dtype=np.uint64,
+                         count=len(self._overflow))
+        vals = list(self._overflow.values())
+        ooff = np.array([v[0] // NEEDLE_PADDING_SIZE for v in vals],
+                        dtype=np.uint64)
+        osz = np.array([v[1] for v in vals], dtype=np.int32)
+        order = np.argsort(ok)
+        ok, ooff, osz = ok[order], ooff[order], osz[order]
+        pos = np.searchsorted(self._keys, ok)
+        self._keys = np.insert(self._keys, pos, ok)
+        self._offs = np.insert(self._offs, pos,
+                               (ooff & 0xFFFFFFFF).astype(np.uint32))
+        if self._offs_hi is not None:
+            self._offs_hi = np.insert(
+                self._offs_hi, pos, (ooff >> np.uint64(32)).astype(np.uint8)
+            )
+        self._sizes = np.insert(self._sizes, pos, osz)
+        self._overflow.clear()
+
+    # -- mutations (CompactNeedleMap-identical semantics) --------------------
+    def put(self, key: int, offset: int, size: int) -> None:
+        with self._lock:
+            old = self._current(key)
+            if key in self._overflow:
+                self._overflow[key] = (offset, size)
+            else:
+                i = self._base_find(key)
+                if i is not None:
+                    self._base_set(i, offset, size)
+                else:
+                    self._overflow[key] = (offset, size)
+                    if len(self._overflow) >= self.MERGE_THRESHOLD:
+                        self._merge_overflow()
+            self.max_file_key = max(self.max_file_key, key)
+            self.file_counter += 1
+            self.file_byte_counter += size
+            if old is not None and old[0] != 0 and size_is_valid(old[1]):
+                self.deletion_counter += 1
+                self.deletion_byte_counter += old[1]
+            self._append_entry(key, offset, size)
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        v = self._current(key)
+        if v is None:
+            return None
+        return NeedleValue(key, v[0], v[1])
+
+    def delete(self, key: int, offset: int) -> None:
+        with self._lock:
+            old = self._current(key)
+            if old is not None and size_is_valid(old[1]):
+                self.deletion_counter += 1
+                self.deletion_byte_counter += old[1]
+                if key in self._overflow:
+                    self._overflow[key] = (old[0], -old[1])
+                else:
+                    i = self._base_find(key)
+                    if i is not None:
+                        self._sizes[i] = -old[1]
+            self._append_entry(key, offset, TOMBSTONE_FILE_SIZE)
+
+    # -- queries -------------------------------------------------------------
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for nv in self._ascending_items():
+            fn(nv)
+
+    def _ascending_items(self) -> Iterator[NeedleValue]:
+        ov = sorted(self._overflow.items())
+        oi = 0
+        for bi in range(len(self._keys)):
+            key = int(self._keys[bi])
+            while oi < len(ov) and ov[oi][0] < key:
+                k, (o, s) = ov[oi]
+                yield NeedleValue(k, o, s)
+                oi += 1
+            off, size = self._base_value(bi)
+            yield NeedleValue(key, off, size)
+        while oi < len(ov):
+            k, (o, s) = ov[oi]
+            yield NeedleValue(k, o, s)
+            oi += 1
+
+    def items(self) -> Iterator[NeedleValue]:
+        return self._ascending_items()
+
+    def __len__(self) -> int:
+        return len(self._keys) + len(self._overflow)
+
+    def bytes_per_entry(self) -> float:
+        """Resident index bytes per entry (diagnostic; the design target is
+        16, matching compact_map.go — overflow entries cost dict rates
+        until merged)."""
+        n = len(self)
+        if n == 0:
+            return 0.0
+        base = (
+            self._keys.nbytes
+            + self._offs.nbytes
+            + self._sizes.nbytes
+            + (self._offs_hi.nbytes if self._offs_hi is not None else 0)
+        )
+        return (base + len(self._overflow) * 100) / n
+
+
+class SqliteNeedleMap(IdxLogMixin, NeedleMapper):
+    """On-disk spill kind for RAM-exceeding volumes (needle_map_leveldb.go).
+
+    Entries live in a SQLite B-tree next to the volume (`<base>.ldb`). The
+    .idx append log stays the durable source of truth (EC encode, copy,
+    and rebuild all read .idx): db commits are deferred to sync()/close(),
+    and a load whose committed meta doesn't match the .idx size (crash,
+    torn tail, compaction) drops the db and replays the .idx vectorized.
+    """
+
+    def __init__(
+        self,
+        index_file: BinaryIO,
+        db_path: str,
+        offset_size: int = OFFSET_SIZE,
+    ):
+        import sqlite3
+
+        self._lock = threading.Lock()
+        self._init_log(index_file, offset_size)
+        self._db_path = db_path
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS needles"
+            " (key INTEGER PRIMARY KEY, offset INTEGER, size INTEGER)"
+        )
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v INTEGER)"
+        )
+
+    _META_KEYS = (
+        "file_counter",
+        "file_byte_counter",
+        "deletion_counter",
+        "deletion_byte_counter",
+        "max_file_key",
+    )
+
+    @classmethod
+    def load(
+        cls,
+        index_file: BinaryIO,
+        db_path: str,
+        offset_size: int = OFFSET_SIZE,
+    ) -> "SqliteNeedleMap":
+        nm = cls(index_file, db_path, offset_size)
+        meta = {k: int(v) for k, v in nm._db.execute("SELECT k, v FROM meta")}
+        idx_size = nm.index_file_size()
+        if meta.get("idx_size", -1) == idx_size:
+            for k in cls._META_KEYS:
+                setattr(nm, k, int(meta.get(k, 0)))
+        else:
+            nm._rebuild_from_idx()
+        index_file.seek(0, io.SEEK_END)
+        return nm
+
+    def _rebuild_from_idx(self) -> None:
+        """Vectorized replay of the .idx (db missing or out of date, e.g.
+        after a crash between an idx append and the next commit)."""
+        self._db.execute("DELETE FROM needles")
+        self._index_file.seek(0)
+        raw = self._index_file.read()
+        metrics, fkeys, foffs, fsizes = replay_idx_vectorized(
+            raw, self._offset_size
+        )
+        self.__dict__.update(metrics)
+        actual = (foffs * np.uint64(NEEDLE_PADDING_SIZE)).astype(np.int64)
+        # vectorized bias shift: (key XOR 2^63) reinterpreted as i64 equals
+        # key - 2^63 for all u64 keys (order-preserving)
+        skeys = (fkeys ^ np.uint64(_KEY_BIAS)).view(np.int64)
+        self._db.executemany(
+            "INSERT INTO needles VALUES (?,?,?)",
+            zip(skeys.tolist(), actual.tolist(), fsizes.tolist()),
+        )
+        self._commit_meta()
+        self._db.commit()
+
+    def _commit_meta(self) -> None:
+        # values stored as text: max_file_key is a full u64 and would
+        # overflow sqlite's signed-integer binding
+        self._db.executemany(
+            "INSERT OR REPLACE INTO meta VALUES (?,?)",
+            [(k, str(getattr(self, k))) for k in self._META_KEYS]
+            + [("idx_size", str(self.index_file_size()))],
+        )
+
+    @staticmethod
+    def _sk(key: int) -> int:
+        """u64 needle key → signed 64-bit sqlite key (order-preserving)."""
+        return key - _KEY_BIAS
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        with self._lock:
+            sk = self._sk(key)
+            row = self._db.execute(
+                "SELECT offset, size FROM needles WHERE key=?", (sk,)
+            ).fetchone()
+            self._db.execute(
+                "INSERT OR REPLACE INTO needles VALUES (?,?,?)",
+                (sk, offset, size),
+            )
+            self.max_file_key = max(self.max_file_key, key)
+            self.file_counter += 1
+            self.file_byte_counter += size
+            if row is not None and row[0] != 0 and size_is_valid(row[1]):
+                self.deletion_counter += 1
+                self.deletion_byte_counter += row[1]
+            self._append_entry(key, offset, size)
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        row = self._db.execute(
+            "SELECT offset, size FROM needles WHERE key=?", (self._sk(key),)
+        ).fetchone()
+        if row is None:
+            return None
+        return NeedleValue(key, row[0], row[1])
+
+    def delete(self, key: int, offset: int) -> None:
+        with self._lock:
+            sk = self._sk(key)
+            row = self._db.execute(
+                "SELECT offset, size FROM needles WHERE key=?", (sk,)
+            ).fetchone()
+            if row is not None and size_is_valid(row[1]):
+                self.deletion_counter += 1
+                self.deletion_byte_counter += row[1]
+                self._db.execute(
+                    "UPDATE needles SET size=? WHERE key=?", (-row[1], sk)
+                )
+            self._append_entry(key, offset, TOMBSTONE_FILE_SIZE)
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for nv in self.items():
+            fn(nv)
+
+    def items(self) -> Iterator[NeedleValue]:
+        for skey, offset, size in self._db.execute(
+            "SELECT key, offset, size FROM needles ORDER BY key"
+        ):
+            yield NeedleValue(skey + _KEY_BIAS, offset, size)
+
+    def __len__(self) -> int:
+        return self._db.execute("SELECT COUNT(*) FROM needles").fetchone()[0]
+
+    def sync(self) -> None:
+        super().sync()
+        with self._lock:
+            self._commit_meta()
+            self._db.commit()
+
+    def release(self) -> None:
+        self._db.close()
+
+    def close(self) -> None:
+        super().close()
+        try:
+            with self._lock:
+                self._commit_meta()
+                self._db.commit()
+            self._db.close()
+        except Exception:
+            pass
+
+    def destroy(self) -> None:
+        self.close()
+        try:
+            os.remove(self._db_path)
+        except FileNotFoundError:
+            pass
+
+
+def write_sorted_index(
+    idx_raw: bytes, sorted_path: str, offset_size: int = OFFSET_SIZE
+) -> None:
+    """Replay an .idx history and write the final state key-sorted (.sdx),
+    the input format of the read-only kind (WriteSortedFileFromIdx,
+    ec_encoder.go:27 is the .ecx sibling of this)."""
+    _, fkeys, foffs, fsizes = replay_idx_vectorized(idx_raw, offset_size)
+    with open(sorted_path + ".tmp", "wb") as f:
+        for i in range(len(fkeys)):
+            f.write(
+                idx_mod.pack_entry(
+                    int(fkeys[i]),
+                    int(foffs[i]) * NEEDLE_PADDING_SIZE,
+                    int(fsizes[i]),
+                    offset_size,
+                )
+            )
+    os.replace(sorted_path + ".tmp", sorted_path)
+
+
+class SortedFileNeedleMap(IdxLogMixin, NeedleMapper):
+    """Read-only kind: binary search a key-sorted index file on disk
+    (needle_map_sorted_file.go:19). Zero resident entries; used for sealed
+    read-only volumes where even 16B/entry is too much."""
+
+    def __init__(
+        self,
+        sorted_path: str,
+        offset_size: int = OFFSET_SIZE,
+        index_file: Optional[BinaryIO] = None,
+    ):
+        self._f = open(sorted_path, "rb")
+        self._entry = needle_map_entry_size(offset_size)
+        self._count = os.fstat(self._f.fileno()).st_size // self._entry
+        self._lock = threading.Lock()
+        self._init_log(index_file or self._f, offset_size)
+        # counters from one streaming pass (transient, nothing resident)
+        raw = self._f.read()
+        keys, offs, sizes = _parse_idx_arrays(raw, offset_size)
+        if len(keys):
+            self.max_file_key = int(keys.max())
+            live = sizes > 0
+            self.file_counter = int(live.sum())
+            self.file_byte_counter = int(sizes[live].sum())
+            self.deletion_counter = int((~live).sum())
+            self.deletion_byte_counter = int(-sizes[~live].sum())
+
+    def _read(self, i: int) -> tuple[int, int, int]:
+        with self._lock:
+            self._f.seek(i * self._entry)
+            return idx_mod.unpack_entry(
+                self._f.read(self._entry), self._offset_size
+            )
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        lo, hi = 0, self._count
+        while lo < hi:
+            mid = (lo + hi) // 2
+            k, off, size = self._read(mid)
+            if k == key:
+                return NeedleValue(k, off, size)
+            if k < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        return None
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        raise io.UnsupportedOperation("sorted-file needle map is read-only")
+
+    def delete(self, key: int, offset: int) -> None:
+        raise io.UnsupportedOperation("sorted-file needle map is read-only")
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for i in range(self._count):
+            k, off, size = self._read(i)
+            fn(NeedleValue(k, off, size))
+
+    def __len__(self) -> int:
+        return self._count
+
+    def close(self) -> None:
+        self._f.close()
+        if self._index_file is not self._f:
+            super().close()

@@ -39,11 +39,16 @@ class Store:
         port: int = 8080,
         public_url: str = "",
         ec_backend: Optional[str] = None,
+        needle_map_kind: str = "dense",
     ):
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
-        self.locations = [DiskLocation(d) for d in directories]
+        self.needle_map_kind = needle_map_kind
+        self.locations = [
+            DiskLocation(d, needle_map_kind=needle_map_kind)
+            for d in directories
+        ]
         for loc in self.locations:
             loc.load_existing_volumes()
         self._ec_codec: Optional[Codec] = None
@@ -84,7 +89,8 @@ class Store:
         if isinstance(ttl, str):
             ttl = read_ttl(ttl) if ttl else EMPTY_TTL
         loc = self._pick_location()
-        v = Volume(loc.directory, collection, vid, replica_placement, ttl)
+        v = Volume(loc.directory, collection, vid, replica_placement, ttl,
+                   needle_map_kind=self.needle_map_kind)
         loc.add_volume(v)
         self.queue_new_volume(v)
         return v

@@ -74,11 +74,17 @@ class Volume:
         version: int = CURRENT_VERSION,
         offset_size: int = OFFSET_SIZE,
         create_if_missing: bool = True,
+        needle_map_kind: str = "dense",
     ):
         self.dir = directory
         self.collection = collection
         self.id = vid
         self.offset_size = offset_size
+        # needle map kind (needle_map.go:12-19): "dense" = 16B/entry packed
+        # arrays (the reference's in-memory CompactMap profile), "memory" =
+        # plain dict, "sqlite" = on-disk B-tree for RAM-exceeding volumes
+        # (the leveldb kind)
+        self.needle_map_kind = needle_map_kind
         self.read_only = False
         self.last_append_at_ns = 0
         self.last_modified_ts_seconds = 0
@@ -133,8 +139,42 @@ class Volume:
         # readers of the file (EC encode reads the .idx of a live volume).
         # One 16-byte write(2) per put matches the reference's os.File.Write.
         idx_file = open(idx_path, "a+b", buffering=0)
-        self.nm = CompactNeedleMap.load(idx_file, offset_size)
+        self.nm = self._load_needle_map(idx_file)
         self.last_append_at_ns = self._check_and_fix_integrity(idx_file)
+
+    def _load_needle_map(self, idx_file):
+        kind = self.needle_map_kind
+        if kind == "memory":
+            return CompactNeedleMap.load(idx_file, self.offset_size)
+        if kind == "dense":
+            from .needle_map_dense import DenseNeedleMap
+
+            return DenseNeedleMap.load(idx_file, self.offset_size)
+        if kind == "sqlite":
+            from .needle_map_dense import SqliteNeedleMap
+
+            return SqliteNeedleMap.load(
+                idx_file, self.file_name() + ".ldb", self.offset_size
+            )
+        if kind == "sorted":
+            # read-only kind for sealed volumes (needle_map_sorted_file.go):
+            # generate/refresh the .sdx from the .idx, then binary-search it
+            # on disk with zero resident entries
+            from .needle_map_dense import (
+                SortedFileNeedleMap,
+                write_sorted_index,
+            )
+
+            base = self.file_name()
+            sdx, idxp = base + ".sdx", base + ".idx"
+            if not os.path.exists(sdx) or (
+                os.path.getmtime(sdx) < os.path.getmtime(idxp)
+            ):
+                with open(idxp, "rb") as f:
+                    write_sorted_index(f.read(), sdx, self.offset_size)
+            self.read_only = True
+            return SortedFileNeedleMap(sdx, self.offset_size, idx_file)
+        raise ValueError(f"unknown needle map kind {kind!r}")
 
     # -- identity ------------------------------------------------------------
     def file_name(self) -> str:
@@ -205,15 +245,11 @@ class Volume:
                 healthy = off
         if healthy < idx_size:
             idx_file.truncate(healthy)
-            # reload the map (entries AND counters) without the torn tail
-            with open(idx_file.name, "rb") as f2:
-                reloaded = CompactNeedleMap.load(f2, self.offset_size)
-            self.nm._m = reloaded._m
-            self.nm.file_counter = reloaded.file_counter
-            self.nm.file_byte_counter = reloaded.file_byte_counter
-            self.nm.deletion_counter = reloaded.deletion_counter
-            self.nm.deletion_byte_counter = reloaded.deletion_byte_counter
-            self.nm.max_file_key = reloaded.max_file_key
+            # reload the map (entries AND counters) without the torn tail;
+            # release() drops any auxiliary handles (sqlite db) while the
+            # shared idx handle stays open
+            self.nm.release()
+            self.nm = self._load_needle_map(idx_file)
         # Truncate any garbage .dat tail past the last verified record —
         # otherwise the next append starts at an unaligned/torn offset. (The
         # reference leaves the tail and its ToOffset silently rounds the
@@ -689,7 +725,7 @@ class Volume:
             self.data_backend.read_at(0, SUPER_BLOCK_SIZE + extra_size)
         )
         idx_file = open(base + ".idx", "a+b", buffering=0)
-        self.nm = CompactNeedleMap.load(idx_file, self.offset_size)
+        self.nm = self._load_needle_map(idx_file)
 
     # -- lifecycle -----------------------------------------------------------
     def sync(self) -> None:
@@ -708,7 +744,8 @@ class Volume:
                 raise VolumeError(f"volume {self.id} is compacting")
             self.close()
             base = self.file_name()
-            for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx", ".note"):
+            for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx",
+                        ".note", ".ldb"):
                 try:
                     os.remove(base + ext)
                 except FileNotFoundError:
