@@ -1,0 +1,109 @@
+"""5-byte offset flavor: full volume + EC cycle beyond the 32GB cap.
+
+The reference's `5BytesOffset` build tag (types/offset_5bytes.go:14,
+Makefile:18 `large_disk`) lifts the 4-byte 32GB volume cap to 8EB. SURVEY
+§7 picked 5-byte semantics for >32GB volumes; VERDICT round-1 weak #8
+flagged that no test drove a volume/EC cycle at offset_size=5. Real >32GB
+files are impractical in CI, so the offset MATH is exercised two ways:
+sparse-file addressing at a real >32GB offset, and a full small-volume
+write/read/delete/compact/EC cycle at offset_size=5.
+"""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import (
+    DeletedError,
+    NotFoundError,
+    Volume,
+)
+
+
+@pytest.mark.parametrize("kind", ["memory", "dense"])
+def test_full_cycle_at_offset_size_5(tmp_path, kind):
+    (tmp_path / kind).mkdir(exist_ok=True)
+    v = Volume(str(tmp_path / kind), "", 1, offset_size=5,
+               needle_map_kind=kind)
+    assert v.offset_size == 5
+    for i in range(1, 41):
+        v.write_needle(Needle(cookie=0x5B, id=i, data=b"five" * i))
+    for i in range(1, 11):
+        v.delete_needle(Needle(id=i, cookie=0x5B))
+    v.compact()
+    for i in range(11, 41):
+        n = Needle(id=i)
+        v.read_needle(n)
+        assert n.data == b"five" * i
+    for i in range(1, 11):
+        with pytest.raises((DeletedError, NotFoundError)):
+            v.read_needle(Needle(id=i))
+    v.close()
+    # reload parses the 17-byte idx entries
+    v2 = Volume(str(tmp_path / kind), "", 1, offset_size=5,
+                create_if_missing=False, needle_map_kind=kind)
+    n = Needle(id=20)
+    v2.read_needle(n)
+    assert n.data == b"five" * 20
+    v2.close()
+
+
+def test_needle_beyond_32gb_addressable(tmp_path):
+    """A needle whose record sits past the 4-byte offset cap (32GB) must
+    round-trip; the .dat is sparse so no real 40GB hits the disk."""
+    v = Volume(str(tmp_path), "", 2, offset_size=5, needle_map_kind="dense")
+    v.write_needle(Needle(cookie=0x5B, id=1, data=b"low"))
+    # punch the append position past 32GB (8-aligned)
+    big = 40 * 1024 * 1024 * 1024
+    v.data_backend.truncate(big)
+    off, _, _ = v.write_needle(Needle(cookie=0x5B, id=2, data=b"high data"))
+    assert off >= big
+    n = Needle(id=2)
+    v.read_needle(n)
+    assert n.data == b"high data"
+    v.sync()
+    # the idx entry encodes the >32GB offset in 5 bytes; reload and re-read
+    v.close()
+    v2 = Volume(str(tmp_path), "", 2, offset_size=5,
+                create_if_missing=False, needle_map_kind="dense")
+    assert v2.nm.get(2).offset >= big
+    n = Needle(id=2)
+    v2.read_needle(n)
+    assert n.data == b"high data"
+    n = Needle(id=1)
+    v2.read_needle(n)
+    assert n.data == b"low"
+    v2.close()
+    # sparse: actual disk usage stays tiny
+    blocks = os.stat(str(tmp_path / "2.dat")).st_blocks
+    assert blocks * 512 < 64 * 1024 * 1024
+
+
+def test_ec_cycle_at_offset_size_5(tmp_path):
+    """EC encode → .ecx search → needle read-through-shards at offset 5."""
+    from seaweedfs_tpu.ec import encoder
+    from seaweedfs_tpu.ec.codec import get_codec
+    from seaweedfs_tpu.ec.ec_volume import EcVolume
+
+    v = Volume(str(tmp_path), "", 3, offset_size=5, needle_map_kind="dense")
+    payloads = {}
+    for i in range(1, 31):
+        data = os.urandom(200 + i * 13)
+        payloads[i] = data
+        v.write_needle(Needle(cookie=0xEC, id=i, data=data))
+    v.sync()
+    base = v.file_name()
+    codec = get_codec("numpy")
+    encoder.write_ec_files(base, codec)
+    encoder.write_sorted_file_from_idx(base, offset_size=5)
+    v.close()
+
+    ev = EcVolume(str(tmp_path), "", 3, offset_size=5)
+    assert len(ev.shards) == 14
+    for i in (1, 7, 15, 30):
+        _, size, _ = ev.locate_needle(i)
+        blob = ev.read_needle_blob(i)
+        n = Needle.from_bytes(blob, size, 3)
+        assert n.data == payloads[i], i
+    ev.close()
