@@ -569,6 +569,60 @@ class VolumeServer:
             return 404, {"error": f"shard {vid}.{sid} not here"}
         return 200, ev.shards[sid].read_at(offset, size)
 
+    def _h_needle_ids(self, h, path, q, body):
+        """List live needle keys of a volume (volume.fsck's raw material;
+        the reference streams the .idx in VolumeServer.CopyFile and the
+        shell parses it — command_volume_fsck.go)."""
+        vid = int(q["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        with_cookies = q.get("cookies") == "true"
+        out = []
+
+        def visit(nv):
+            if nv.size < 0 or nv.offset == 0:
+                return
+            rec = {"key": nv.key, "size": nv.size}
+            if with_cookies:
+                hdr = v.data_backend.read_at(nv.offset, 4)
+                rec["cookie"] = int.from_bytes(hdr, "big")
+            out.append(rec)
+
+        v.nm.ascending_visit(visit)
+        return 200, {"volume": vid, "needles": out}
+
+    def _h_needle_info(self, h, path, q, body):
+        """One needle's index entry + append timestamp (fsck's purge-safety
+        check reads append_ns to skip in-flight uploads)."""
+        from ..storage.needle import get_actual_size
+
+        vid = int(q["volume"])
+        key = int(q["key"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": f"volume {vid} not found"}
+        nv = v.nm.get(key)
+        if nv is None or nv.offset == 0:
+            return 404, {"error": f"needle {key:x} not found"}
+        append_ns = 0
+        if nv.size >= 0 and v.version >= 3:
+            try:
+                blob = v.data_backend.read_at(
+                    nv.offset, get_actual_size(nv.size, v.version)
+                )
+                n = Needle.from_bytes(blob, nv.size, v.version,
+                                      verify_crc=False)
+                append_ns = n.append_at_ns
+            except Exception:
+                pass
+        return 200, {
+            "key": key,
+            "offset": nv.offset,
+            "size": nv.size,
+            "append_ns": append_ns,
+        }
+
     def _h_metrics(self, h, path, q, body):
         return 200, self.metrics.expose().encode()
 
@@ -576,6 +630,27 @@ class VolumeServer:
         hb = self.store.collect_heartbeat()
         hb["ec"] = self.store.collect_ec_heartbeat()["ec_shards"]
         return 200, hb
+
+    def _h_ui(self, h, path, q, body):
+        """Embedded status page (server/volume_server_ui analog)."""
+        from .status_ui import render_status_page
+
+        hb = self.store.collect_heartbeat()
+        h.extra_headers = {"Content-Type": "text/html; charset=utf-8"}
+        return 200, render_status_page(
+            f"seaweedfs_tpu volume server {self.host}:{self.port}",
+            {
+                "Server": {
+                    "master": self.master_url,
+                    "data_center": self.data_center,
+                    "rack": self.rack,
+                    "max_volume_count": self.max_volume_count,
+                    "needle_map_kind": self.store.needle_map_kind,
+                },
+                "Volumes": hb["volumes"],
+                "EC shards": self.store.collect_ec_heartbeat()["ec_shards"],
+            },
+        )
 
     # -- heartbeat loop (volume_grpc_client_to_master.go:50) -----------------
     def _send_beat(self, hb: dict) -> None:
@@ -681,7 +756,10 @@ class VolumeServer:
                 ("POST", "/admin/ec/unmount", vs._h_ec_unmount),
                 ("POST", "/admin/ec/delete_shards", vs._h_ec_delete_shards),
                 ("GET", "/admin/file", vs._h_file),
+                ("GET", "/admin/needle_ids", vs._h_needle_ids),
+                ("GET", "/admin/needle_info", vs._h_needle_info),
                 ("GET", "/status", vs._h_status),
+                ("GET", "/ui", vs._h_ui),
                 ("GET", "/metrics", vs._h_metrics),
                 ("GET", "/", vs._h_get),
                 ("HEAD", "/", vs._h_get),

@@ -24,6 +24,8 @@ from ..server.http_util import http_json
 class CommandEnv:
     master: str
     token: Optional[str] = None
+    filer: str = ""  # filer url for fs.* / bucket.* / fsck commands
+    cwd: str = "/"  # fs.* working directory (command_fs_cd.go)
 
     def lock(self) -> str:
         r = http_json("POST", f"http://{self.master}/cluster/lock?client=shell")
@@ -360,3 +362,422 @@ def volume_tier_download(env: CommandEnv, vid: int) -> dict:
         r = http_json("POST", f"http://{loc}/admin/tier_download?volume={vid}")
         results.append({"server": loc} | r)
     return {"downloaded": results}
+
+
+# -- volume move / balance / evacuate (command_volume_balance.go,
+#    command_volume_move.go, command_volume_server_evacuate.go) -------------
+def volume_move(
+    env: CommandEnv, vid: int, target: str, source: str = ""
+) -> dict:
+    """Move one volume replica: copy to target, then delete at source
+    (command_volume_move.go — VolumeCopy + delete, the instant delta
+    heartbeats keep master lookups consistent throughout)."""
+    locs = env.volume_locations(vid)
+    if not locs:
+        raise RuntimeError(f"volume {vid} has no locations")
+    source = source or locs[0]
+    if source not in locs:
+        raise RuntimeError(f"{source} does not hold volume {vid}")
+    if target in locs:
+        raise RuntimeError(f"{target} already holds volume {vid}")
+    collection = _volume_collection(env, vid)
+    if not _copy_volume(env, vid, source, target, collection):
+        raise RuntimeError(f"copy {vid} {source}→{target} failed")
+    r = http_json(
+        "POST", f"http://{source}/admin/delete_volume?volume={vid}"
+    )
+    if r.get("error"):
+        raise RuntimeError(f"delete {vid} on {source}: {r['error']}")
+    return {"vid": vid, "from": source, "to": target}
+
+
+def _balance_plan(
+    volumes: list[dict], nodes: list[dict], collection: Optional[str]
+) -> list[dict]:
+    """Greedy move plan toward count/capacity parity — the reference's
+    balanceVolumeServers score `localVolumeRatio = count/maxCount`
+    (command_volume_balance.go:124-170), moving from the fullest ratio to
+    the emptiest until within one volume of ideal."""
+    caps = {n["url"]: max(n.get("max", 1), 1) for n in nodes}
+    held: dict[str, set[int]] = {n["url"]: set() for n in nodes}
+    movable: dict[str, list[dict]] = {n["url"]: [] for n in nodes}
+    for v in volumes:
+        if v["server"] not in held:
+            continue
+        held[v["server"]].add(v["id"])
+        if collection is None or v.get("collection", "") == collection:
+            movable[v["server"]].append(v)
+    plan = []
+    counts = {u: len(vs) for u, vs in held.items()}
+    for _ in range(1000):  # hard stop, each iteration moves one volume
+        ratios = {u: counts[u] / caps[u] for u in counts}
+        src = max(ratios, key=ratios.get)
+        dsts = sorted(ratios, key=ratios.get)
+        # moving one volume must strictly reduce the spread
+        moved = False
+        for dst in dsts:
+            if dst == src or ratios[src] - ratios[dst] <= 1.0 / caps[src]:
+                break
+            cand = next(
+                (v for v in movable[src] if v["id"] not in held[dst]), None
+            )
+            if cand is None:
+                continue
+            plan.append({"vid": cand["id"], "from": src, "to": dst})
+            movable[src].remove(cand)
+            held[src].discard(cand["id"])
+            held[dst].add(cand["id"])
+            movable[dst].append(cand)
+            counts[src] -= 1
+            counts[dst] += 1
+            moved = True
+            break
+        if not moved:
+            break
+    return plan
+
+
+def volume_balance(
+    env: CommandEnv, collection: Optional[str] = None, apply: bool = True
+) -> dict:
+    """Even out volume counts per server capacity
+    (command_volume_balance.go). apply=False returns the plan only."""
+    plan = _balance_plan(volume_list(env), env.data_nodes(), collection)
+    moved = []
+    if apply:
+        for m in plan:
+            volume_move(env, m["vid"], m["to"], m["from"])
+            moved.append(m)
+    return {"plan": plan, "moved": moved}
+
+
+def volume_server_evacuate(
+    env: CommandEnv, server: str, apply: bool = True
+) -> dict:
+    """Move every volume and EC shard off one server
+    (command_volume_server_evacuate.go) so it can be retired."""
+    nodes = [n for n in env.data_nodes() if n["url"] != server]
+    if not nodes:
+        raise RuntimeError("no other servers to evacuate to")
+    st = env.node_status(server)
+    held_elsewhere: dict[int, set[str]] = {}
+    for v in volume_list(env):
+        held_elsewhere.setdefault(v["id"], set()).add(v["server"])
+    counts = {n["url"]: n.get("volumes", 0) for n in nodes}
+    moves, ec_moves = [], []
+    for v in st.get("volumes", []):
+        vid = v["id"]
+        targets = sorted(
+            (u for u in counts if u not in held_elsewhere.get(vid, ())),
+            key=counts.get,
+        )
+        if not targets:
+            raise RuntimeError(f"no target free of volume {vid}")
+        if apply:
+            volume_move(env, vid, targets[0], server)
+        counts[targets[0]] += 1
+        moves.append({"vid": vid, "to": targets[0]})
+    for s in st.get("ec", []):
+        vid = s["id"]
+        sids = [
+            i for i in range(TOTAL_SHARDS) if s["ec_index_bits"] & (1 << i)
+        ]
+        target = min(counts, key=counts.get)
+        counts[target] += 1  # spread successive shard groups across nodes
+        if apply:
+            shard_csv = ",".join(map(str, sids))
+            r = http_json(
+                "POST",
+                f"http://{target}/admin/ec/copy?volume={vid}&source={server}"
+                f"&shards={shard_csv}&collection={s.get('collection', '')}",
+            )
+            if r.get("error"):
+                raise RuntimeError(f"ec copy {vid}: {r['error']}")
+            http_json("POST", f"http://{target}/admin/ec/mount?volume={vid}")
+            http_json(
+                "POST",
+                f"http://{server}/admin/ec/delete_shards?volume={vid}"
+                f"&shards={shard_csv}",
+            )
+            http_json("POST", f"http://{server}/admin/ec/unmount?volume={vid}")
+        ec_moves.append({"vid": vid, "shards": sids, "to": target})
+    return {"volumes": moves, "ec": ec_moves}
+
+
+# -- fsck (command_volume_fsck.go) ------------------------------------------
+def _walk_filer(filer: str, path: str = "/"):
+    """Yield every entry dict (meta=true) under path, recursively, paging
+    through lastFileName so huge directories are fully covered. The
+    trailing slash asks the filer for a LISTING with full metadata (a
+    slashless dir path + meta=true returns the dir's own entry)."""
+    page_size = 1000
+    cursor = ""
+    while True:
+        r = http_json(
+            "GET",
+            f"http://{filer}{path.rstrip('/')}/?limit={page_size}&meta=true"
+            f"&lastFileName={cursor}",
+        )
+        entries = r.get("entries", [])
+        for e in entries:
+            child = (path.rstrip("/") + "/" + e["name"]) or "/"
+            if e.get("is_directory"):
+                yield from _walk_filer(filer, child)
+            else:
+                yield child, e
+        if len(entries) < page_size:
+            return
+        cursor = r.get("lastFileName", "") or entries[-1]["name"]
+
+
+def volume_fsck(
+    env: CommandEnv,
+    filer: str,
+    apply: bool = False,
+    cutoff_seconds: float = 300.0,
+) -> dict:
+    """Orphan-needle detection: needles present in volumes but referenced by
+    no filer entry (command_volume_fsck.go). apply=True purges orphans via
+    the normal delete path.
+
+    Race safety (the reference's cutoffTimeNs): volumes are scanned BEFORE
+    the filer walk, so a needle uploaded after the scan can't be flagged;
+    and a purge is skipped for any needle appended within cutoff_seconds —
+    an in-flight upload whose filer entry hasn't landed yet is never
+    deleted."""
+    import time as _time
+
+    from ..storage.file_id import parse_path
+
+    cutoff_ns = (_time.time() - cutoff_seconds) * 1e9
+    # 1. snapshot volume needles first
+    volume_needles: list[dict] = []
+    for v in volume_list(env):
+        r = http_json(
+            "GET",
+            f"http://{v['server']}/admin/needle_ids?volume={v['id']}"
+            "&cookies=true",
+        )
+        for n in r.get("needles", []):
+            volume_needles.append(
+                {**n, "vid": v["id"], "server": v["server"]}
+            )
+    # 2. then collect every fid the filer references
+    referenced: dict[int, set[int]] = {}
+    for _, e in _walk_filer(filer):
+        for c in e.get("chunks", []):
+            fid = c.get("file_id", "")
+            if "," not in fid:
+                continue
+            vid_s, rest = fid.split(",", 1)
+            try:
+                key, _cookie = parse_path(rest)
+            except ValueError:
+                continue
+            referenced.setdefault(int(vid_s), set()).add(key)
+    orphans = [
+        {
+            "vid": n["vid"],
+            "key": n["key"],
+            "size": n["size"],
+            "cookie": n.get("cookie", 0),
+            "server": n["server"],
+        }
+        for n in volume_needles
+        if n["key"] not in referenced.get(n["vid"], set())
+    ]
+    purged = 0
+    if apply:
+        from ..server.http_util import http_bytes
+        from ..storage.file_id import format_needle_id_cookie
+
+        for o in orphans:
+            info = http_json(
+                "GET",
+                f"http://{o['server']}/admin/needle_info"
+                f"?volume={o['vid']}&key={o['key']}",
+            )
+            if info.get("append_ns", 0) > cutoff_ns:
+                continue  # too fresh: may be an in-flight upload
+            fid = f"{o['vid']},{format_needle_id_cookie(o['key'], o['cookie'])}"
+            status, _ = http_bytes("DELETE", f"http://{o['server']}/{fid}")
+            if status in (200, 202, 204):
+                purged += 1
+    return {"orphans": orphans, "purged": purged}
+
+
+# -- fs.* (shell/command_fs_*.go) -------------------------------------------
+def _fs_resolve(env: CommandEnv, path: Optional[str]) -> str:
+    cwd = getattr(env, "cwd", "/") or "/"
+    if not path:
+        return cwd
+    if not path.startswith("/"):
+        path = cwd.rstrip("/") + "/" + path
+    # normalize . and ..
+    parts = []
+    for seg in path.split("/"):
+        if seg in ("", "."):
+            continue
+        if seg == "..":
+            if parts:
+                parts.pop()
+        else:
+            parts.append(seg)
+    return "/" + "/".join(parts)
+
+
+def _list_dir(filer: str, path: str) -> list[dict]:
+    """Full directory listing, paging through lastFileName (a fixed limit
+    would silently truncate huge directories)."""
+    page_size = 1000
+    cursor = ""
+    out: list[dict] = []
+    while True:
+        r = http_json(
+            "GET",
+            f"http://{filer}{path.rstrip('/') or ''}/?limit={page_size}"
+            f"&lastFileName={cursor}",
+        )
+        if r.get("error"):
+            raise RuntimeError(r["error"])
+        entries = r.get("entries", [])
+        out.extend(entries)
+        if len(entries) < page_size:
+            return out
+        cursor = r.get("lastFileName", "") or entries[-1]["name"]
+
+
+def fs_cd(env: CommandEnv, path: str) -> str:
+    target = _fs_resolve(env, path)
+    r = http_json("GET", f"http://{env.filer}{target}?limit=1")
+    if r.get("error") and target != "/":
+        raise RuntimeError(f"no such directory {target}")
+    env.cwd = target
+    return target
+
+
+def fs_ls(env: CommandEnv, path: Optional[str] = None) -> list[dict]:
+    target = _fs_resolve(env, path)
+    # meta=true on a slashless path returns the entry itself (file OR dir)
+    # as JSON — a bare GET on a file would stream its content
+    r = http_json("GET", f"http://{env.filer}{target}?meta=true")
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    if "entries" in r:  # "/" keeps its trailing slash → already a listing
+        return _list_dir(env.filer, target)
+    if not r.get("is_directory"):
+        return [r]  # a file
+    return _list_dir(env.filer, target)
+
+
+def fs_du(env: CommandEnv, path: Optional[str] = None) -> dict:
+    """Recursive usage: bytes/files/dirs under path (command_fs_du.go)."""
+    target = _fs_resolve(env, path)
+    total, files, dirs = 0, 0, 0
+    stack = [target]
+    while stack:
+        p = stack.pop()
+        for e in _list_dir(env.filer, p):
+            child = p.rstrip("/") + "/" + e["name"]
+            if e.get("is_directory"):
+                dirs += 1
+                stack.append(child)
+            else:
+                files += 1
+                total += e.get("size", 0)
+    return {"path": target, "bytes": total, "files": files, "dirs": dirs}
+
+
+def fs_tree(env: CommandEnv, path: Optional[str] = None) -> str:
+    """Render the directory tree (command_fs_tree.go)."""
+    target = _fs_resolve(env, path)
+    lines = [target]
+
+    def rec(p: str, indent: str) -> None:
+        entries = _list_dir(env.filer, p)
+        for i, e in enumerate(entries):
+            last = i == len(entries) - 1
+            lines.append(
+                f"{indent}{'└── ' if last else '├── '}{e['name']}"
+                + ("/" if e.get("is_directory") else "")
+            )
+            if e.get("is_directory"):
+                rec(
+                    p.rstrip("/") + "/" + e["name"],
+                    indent + ("    " if last else "│   "),
+                )
+
+    rec(target, "")
+    return "\n".join(lines)
+
+
+def fs_meta_save(
+    env: CommandEnv, out_path: str, path: Optional[str] = None
+) -> dict:
+    """Dump every entry's full metadata under path as JSON lines
+    (command_fs_meta_save.go; the reference writes protobuf chunks)."""
+    import json as _json
+
+    target = _fs_resolve(env, path)
+    n = 0
+    with open(out_path, "w") as f:
+        for full, e in _walk_filer(env.filer, target):
+            e = dict(e)
+            e["full_path"] = full
+            f.write(_json.dumps(e) + "\n")
+            n += 1
+    return {"saved": n, "file": out_path}
+
+
+def fs_meta_load(env: CommandEnv, in_path: str) -> dict:
+    """Replay a meta dump into the filer (command_fs_meta_load.go) — raw
+    entries, chunks and all; no data is re-uploaded. Uses the filer's
+    existing raw-metadata write (POST <path>?meta=true), which keeps
+    filer.conf reloads and peer-sync signatures on the normal path."""
+    import json as _json
+
+    n = 0
+    with open(in_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            d = _json.loads(line)
+            r = http_json(
+                "POST",
+                f"http://{env.filer}{d['full_path']}?meta=true",
+                _json.dumps(d).encode(),
+            )
+            if r.get("error"):
+                raise RuntimeError(f"{d.get('full_path')}: {r['error']}")
+            n += 1
+    return {"loaded": n}
+
+
+# -- bucket.* (shell/command_bucket_*.go) -----------------------------------
+BUCKETS_PATH = "/buckets"
+
+
+def bucket_list(env: CommandEnv) -> list[str]:
+    r = http_json("GET", f"http://{env.filer}{BUCKETS_PATH}?limit=10000")
+    return [e["name"] for e in r.get("entries", []) if e.get("is_directory")]
+
+
+def bucket_create(env: CommandEnv, name: str) -> dict:
+    r = http_json(
+        "POST", f"http://{env.filer}{BUCKETS_PATH}/{name}/?mkdir=true"
+    )
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return {"created": name}
+
+
+def bucket_delete(env: CommandEnv, name: str) -> dict:
+    from ..server.http_util import http_bytes
+
+    status, _ = http_bytes(
+        "DELETE",
+        f"http://{env.filer}{BUCKETS_PATH}/{name}?recursive=true",
+    )
+    if status not in (200, 204):
+        raise RuntimeError(f"delete bucket {name}: http {status}")
+    return {"deleted": name}

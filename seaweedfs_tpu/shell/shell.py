@@ -15,10 +15,17 @@ HELP = """commands:
   volume.delete -volumeId=N           delete a volume everywhere
   volume.mark.readonly -volumeId=N    seal a volume
   volume.fix.replication              re-replicate under-replicated volumes
+  volume.move -volumeId=N -target=host:port [-source=host:port]
+  volume.balance [-collection=C] [-force=true]  plan (and apply) even spread
+  volumeServer.evacuate -node=host:port         drain a server
+  volume.fsck [-apply=true]                     find orphan needles vs filer
   ec.encode -volumeId=N [-collection=C]   erasure-code + spread a volume
   ec.rebuild -volumeId=N                  rebuild missing shards
   ec.balance                              even out shard spread
   collection.list | collection.delete -collection=C
+  fs.cd PATH | fs.ls [PATH] | fs.du [PATH] | fs.tree [PATH]
+  fs.meta.save -o=FILE [PATH] | fs.meta.load -i=FILE
+  bucket.list | bucket.create -name=B | bucket.delete -name=B
   lock | unlock
   help | exit
 """
@@ -38,10 +45,43 @@ def run_command(env: CommandEnv, line: str) -> object:
     if not parts:
         return None
     cmd, flags = parts[0], _flags(parts[1:])
+    args = [p for p in parts[1:] if not p.startswith("-")]
     if cmd in ("exit", "quit"):
         raise EOFError
     if cmd == "help":
         return HELP
+    if cmd == "volume.move":
+        return C.volume_move(
+            env, int(flags["volumeId"]), flags["target"],
+            flags.get("source", ""),
+        )
+    if cmd == "volume.balance":
+        # plan-only unless -force (command_volume_balance.go's opt-in)
+        return C.volume_balance(
+            env, flags.get("collection"), apply=flags.get("force") == "true"
+        )
+    if cmd == "volumeServer.evacuate":
+        return C.volume_server_evacuate(env, flags["node"])
+    if cmd == "volume.fsck":
+        return C.volume_fsck(env, env.filer, apply=flags.get("apply") == "true")
+    if cmd == "fs.cd":
+        return C.fs_cd(env, args[0] if args else "/")
+    if cmd == "fs.ls":
+        return C.fs_ls(env, args[0] if args else None)
+    if cmd == "fs.du":
+        return C.fs_du(env, args[0] if args else None)
+    if cmd == "fs.tree":
+        return C.fs_tree(env, args[0] if args else None)
+    if cmd == "fs.meta.save":
+        return C.fs_meta_save(env, flags["o"], args[0] if args else None)
+    if cmd == "fs.meta.load":
+        return C.fs_meta_load(env, flags["i"])
+    if cmd == "bucket.list":
+        return C.bucket_list(env)
+    if cmd == "bucket.create":
+        return C.bucket_create(env, flags["name"])
+    if cmd == "bucket.delete":
+        return C.bucket_delete(env, flags["name"])
     if cmd == "cluster.status":
         return C.cluster_status(env)
     if cmd == "volume.list":
@@ -78,8 +118,8 @@ def run_command(env: CommandEnv, line: str) -> object:
     return f"unknown command {cmd!r} (try help)"
 
 
-def run_shell(master: str) -> None:
-    env = CommandEnv(master)
+def run_shell(master: str, filer: str = "") -> None:
+    env = CommandEnv(master, filer=filer)
     print(f"connected to master {master}; 'help' for commands")
     while True:
         try:

@@ -1,0 +1,240 @@
+"""Operator-surface shell commands: move/balance/evacuate/fsck/fs.*/bucket.*
+
+Matches the reference's daily-driver shell tools
+(weed/shell/command_volume_balance.go, command_volume_move.go,
+command_volume_server_evacuate.go, command_volume_fsck.go,
+command_fs_*.go, command_bucket_*.go) against a real localhost cluster.
+"""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.http_util import http_bytes, http_json
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import commands as C
+from seaweedfs_tpu.shell.commands import CommandEnv
+from seaweedfs_tpu.shell.shell import run_command
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def trio(tmp_path):
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    servers = [
+        VolumeServer(
+            [str(tmp_path / f"srv{i}")],
+            port=free_port(),
+            master_url=master.url,
+            max_volume_count=10,
+            pulse_seconds=0.4,
+            ec_backend="cpu",
+        ).start()
+        for i in range(3)
+    ]
+    env = CommandEnv(master.url)
+    deadline = time.time() + 5
+    while time.time() < deadline and len(env.data_nodes()) < 3:
+        time.sleep(0.1)
+    yield master, servers, env
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def wait_for(cond, timeout=6.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    return None
+
+
+def test_volume_move(trio):
+    master, servers, env = trio
+    a = operation.assign(master.url)
+    operation.upload_data(a.url, a.fid, b"move me")
+    vid = int(a.fid.split(",")[0])
+    src = a.url
+    target = next(
+        f"{s.host}:{s.port}" for s in servers
+        if f"{s.host}:{s.port}" not in env.volume_locations(vid)
+    )
+    res = C.volume_move(env, vid, target, src)
+    assert res["to"] == target
+    assert wait_for(
+        lambda: src not in env.volume_locations(vid)
+        and target in env.volume_locations(vid)
+    )
+    assert operation.download(master.url, a.fid) == b"move me"
+
+
+def test_volume_balance_evens_spread(trio):
+    master, servers, env = trio
+    # grow a pile of volumes (they may start skewed across servers)
+    for _ in range(3):
+        http_json("POST", f"http://{master.url}/vol/grow?count=3")
+    time.sleep(0.5)
+    res = C.volume_balance(env)
+    # post-balance: per-server counts within 1 of each other
+    def counts():
+        by = {}
+        for v in C.volume_list(env):
+            by[v["server"]] = by.get(v["server"], 0) + 1
+        return by
+
+    assert wait_for(
+        lambda: len(counts()) >= 2 and max(counts().values()) - min(counts().values()) <= 1
+    ), f"unbalanced after balance: {counts()} (plan {res['plan']})"
+    # idempotent: a second run plans nothing
+    res2 = C.volume_balance(env, apply=False)
+    assert res2["plan"] == []
+
+
+def test_evacuate_drains_server(trio):
+    master, servers, env = trio
+    a = operation.assign(master.url)
+    operation.upload_data(a.url, a.fid, b"evacuee")
+    victim = a.url
+    res = C.volume_server_evacuate(env, victim)
+    assert res["volumes"]
+    vs = next(s for s in servers if f"{s.host}:{s.port}" == victim)
+    st = http_json("GET", f"http://{victim}/status")
+    assert st["volumes"] == []
+    # data still readable through the master
+    assert wait_for(
+        lambda: victim not in env.volume_locations(int(a.fid.split(",")[0]))
+    )
+    assert operation.download(master.url, a.fid) == b"evacuee"
+
+
+@pytest.fixture()
+def filer_cluster(tmp_path):
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    vs = VolumeServer(
+        [str(tmp_path / "v")],
+        port=free_port(),
+        master_url=master.url,
+        max_volume_count=10,
+        pulse_seconds=0.4,
+    ).start()
+    fs = FilerServer(
+        port=free_port(),
+        master_url=master.url,
+        db_path=str(tmp_path / "filer.db"),
+    ).start()
+    env = CommandEnv(master.url, filer=fs.url)
+    time.sleep(0.6)
+    yield master, vs, fs, env
+    fs.stop()
+    vs.stop()
+    master.stop()
+
+
+def put_file(filer_url, path, data):
+    status, _ = http_bytes("POST", f"http://{filer_url}{path}", data)
+    assert status in (200, 201), (path, status)
+
+
+def test_fs_commands(filer_cluster, tmp_path):
+    master, vs, fs, env = filer_cluster
+    put_file(fs.url, "/dir/a.txt", b"aaaa")
+    put_file(fs.url, "/dir/sub/b.txt", b"bbbbbbbb")
+    put_file(fs.url, "/top.txt", b"t")
+    # ls
+    names = {e["name"] for e in C.fs_ls(env, "/")}
+    assert {"dir", "top.txt"} <= names
+    # cd + relative ls
+    C.fs_cd(env, "/dir")
+    assert env.cwd == "/dir"
+    names = {e["name"] for e in C.fs_ls(env)}
+    assert names == {"a.txt", "sub"}
+    # du
+    du = C.fs_du(env, "/dir")
+    assert du["files"] == 2 and du["bytes"] == 12 and du["dirs"] == 1
+    # tree
+    tree = C.fs_tree(env, "/dir")
+    assert "a.txt" in tree and "sub/" in tree and "b.txt" in tree
+    # meta.save / meta.load round-trip through a second filer namespace
+    dump = tmp_path / "meta.jsonl"
+    saved = C.fs_meta_save(env, str(dump), "/dir")
+    assert saved["saved"] == 2
+    # restore the dump into a SECOND filer over the same volumes (raw
+    # metadata only; chunk data is reused, nothing re-uploaded)
+    fs2 = FilerServer(port=free_port(), master_url=master.url).start()
+    try:
+        env2 = CommandEnv(master.url, filer=fs2.url)
+        loaded = C.fs_meta_load(env2, str(dump))
+        assert loaded["loaded"] == 2
+        status, data = http_bytes("GET", f"http://{fs2.url}/dir/a.txt")
+        assert status == 200 and data == b"aaaa"
+        status, data = http_bytes("GET", f"http://{fs2.url}/dir/sub/b.txt")
+        assert status == 200 and data == b"bbbbbbbb"
+    finally:
+        fs2.stop()
+
+
+def test_bucket_commands(filer_cluster):
+    master, vs, fs, env = filer_cluster
+    assert C.bucket_list(env) == []
+    C.bucket_create(env, "photos")
+    C.bucket_create(env, "logs")
+    assert sorted(C.bucket_list(env)) == ["logs", "photos"]
+    put_file(fs.url, "/buckets/photos/x.jpg", b"jpegdata")
+    C.bucket_delete(env, "photos")
+    assert C.bucket_list(env) == ["logs"]
+
+
+def test_fsck_finds_planted_orphan(filer_cluster):
+    master, vs, fs, env = filer_cluster
+    # referenced file through the filer
+    put_file(fs.url, "/keep.txt", b"referenced data")
+    # orphan: written straight to the volume layer, no filer entry
+    a = operation.assign(master.url)
+    operation.upload_data(a.url, a.fid, b"orphan blob")
+    orphan_key = int(a.fid.split(",")[1][:-8], 16)
+
+    res = C.volume_fsck(env, fs.url)
+    keys = {o["key"] for o in res["orphans"]}
+    assert orphan_key in keys
+    # the referenced file's needle is NOT flagged
+    st, body = http_bytes("GET", f"http://{fs.url}/keep.txt")
+    assert st == 200 and body == b"referenced data"
+    ref_entry = http_json("GET", f"http://{fs.url}/keep.txt?meta=true")
+    ref_keys = {
+        int(c["file_id"].split(",")[1][:-8], 16)
+        for c in ref_entry.get("chunks", [])
+    }
+    assert not (ref_keys & keys)
+    # the default cutoff protects fresh needles (in-flight uploads)
+    res_protected = C.volume_fsck(env, fs.url, apply=True)
+    assert res_protected["purged"] == 0
+    assert operation.download(master.url, a.fid) == b"orphan blob"
+    # purge with cutoff disabled: orphan gone, referenced data intact
+    res2 = C.volume_fsck(env, fs.url, apply=True, cutoff_seconds=0)
+    assert res2["purged"] >= 1
+    with pytest.raises(RuntimeError):
+        operation.download(master.url, a.fid)
+    st, body = http_bytes("GET", f"http://{fs.url}/keep.txt")
+    assert st == 200 and body == b"referenced data"
+
+
+def test_repl_dispatch(trio):
+    master, servers, env = trio
+    # default is plan-only (the reference applies only with -force)
+    out = run_command(env, "volume.balance")
+    assert "plan" in out and out["moved"] == []
+    assert "unknown command" in run_command(env, "bogus.cmd")
