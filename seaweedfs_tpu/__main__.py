@@ -21,9 +21,26 @@ import sys
 import time
 
 
+def _security_conf():
+    """security.toml (weed/util/config.go + security.toml scaffold)."""
+    from .util.config import load_configuration
+
+    sec = load_configuration("security")
+    wl = sec.get("guard.white_list", []) or []
+    if isinstance(wl, str):  # env override arrives as a comma-joined string
+        wl = [s.strip() for s in wl.split(",") if s.strip()]
+    return {
+        "jwt_signing_key": sec.get("jwt.signing.key", "") or "",
+        "jwt_read_key": sec.get("jwt.signing.read.key", "") or "",
+        "jwt_expires": int(sec.get("jwt.signing.expires_after_seconds", 10)),
+        "whitelist": list(wl),
+    }
+
+
 def cmd_master(args):
     from .server.master_server import MasterServer
 
+    sec = _security_conf()
     peers = [p.strip() for p in args.peers.split(",") if p.strip()]
     ms = MasterServer(
         host=args.ip,
@@ -32,6 +49,8 @@ def cmd_master(args):
         default_replication=args.default_replication,
         peers=peers or None,
         meta_dir=args.mdir or None,
+        jwt_signing_key=sec["jwt_signing_key"],
+        jwt_expires_seconds=sec["jwt_expires"],
     ).start()
     print(f"master listening on {ms.url}")
     _wait_forever()
@@ -40,6 +59,7 @@ def cmd_master(args):
 def cmd_volume(args):
     from .server.volume_server import VolumeServer
 
+    sec = _security_conf()
     dirs = args.dir.split(",")
     vs = VolumeServer(
         dirs,
@@ -51,6 +71,9 @@ def cmd_volume(args):
         max_volume_count=args.max,
         ec_backend=args.ec_backend or None,
         needle_map_kind=args.index,
+        jwt_signing_key=sec["jwt_signing_key"],
+        jwt_read_key=sec["jwt_read_key"],
+        whitelist=sec["whitelist"] or None,
     ).start()
     print(f"volume server on {vs.host}:{vs.port} → master {args.mserver}")
     _wait_forever()
@@ -76,18 +99,26 @@ def cmd_server(args):
 
 def cmd_filer(args):
     from .server.filer_server import FilerServer
+    from .util.config import load_configuration
 
+    # filer.toml store selection (first enabled store wins); explicit -db
+    # beats the config file
+    db_path = args.db
+    conf = load_configuration("filer")
+    if db_path == ":memory:" and conf.get_bool("sqlite.enabled"):
+        db_path = conf.get("sqlite.dbFile", "./filer.db")
     fs = FilerServer(
         host=args.ip,
         port=args.port,
         master_url=args.master,
         chunk_size=args.chunk_size_mb * 1024 * 1024,
-        db_path=args.db,
+        db_path=db_path,
         collection=args.collection,
         replication=args.replication,
         cipher=args.encrypt_volume_data,
         peers=[p for p in args.peers.split(",") if p],
         meta_log_dir=args.meta_log_dir,
+        jwt_signing_key=_security_conf()["jwt_signing_key"],
     ).start()
     print(f"filer on {fs.url} → master {args.master}")
     _wait_forever()
@@ -335,30 +366,17 @@ def cmd_watch(args):
 
 
 def cmd_scaffold(args):
-    """Print config templates (weed scaffold)."""
-    templates = {
-        "security": (
-            "# security.json — shared JWT signing keys + whitelist\n"
-            '{\n  "jwt_signing_key": "<random-secret>",\n'
-            '  "jwt_read_key": "",\n  "whitelist": []\n}\n'
-        ),
-        "s3": (
-            "# s3.json — identities for the S3 gateway\n"
-            '{\n  "identities": [\n    {\n      "name": "admin",\n'
-            '      "credentials": [{"accessKey": "AKEXAMPLE", '
-            '"secretKey": "SKEXAMPLE"}],\n      "actions": ["Admin"]\n'
-            "    }\n  ]\n}\n"
-        ),
-        "filer": (
-            "# filer.json — filer store selection\n"
-            '{\n  "store": "sqlite",\n  "db_path": "./filer.db"\n}\n'
-        ),
-        "replication": (
-            "# replication.json — sink for filer.replicate\n"
-            '{\n  "sink": "s3",\n  "endpoint": "http://127.0.0.1:8333",\n'
-            '  "bucket": "mirror"\n}\n'
-        ),
-    }
+    """Print config templates (weed scaffold → <name>.toml)."""
+    from .util.config import SCAFFOLDS
+
+    templates = dict(SCAFFOLDS)
+    templates["s3"] = (
+        "# s3.json — identities for the S3 gateway\n"
+        '{\n  "identities": [\n    {\n      "name": "admin",\n'
+        '      "credentials": [{"accessKey": "AKEXAMPLE", '
+        '"secretKey": "SKEXAMPLE"}],\n      "actions": ["Admin"]\n'
+        "    }\n  ]\n}\n"
+    )
     print(templates.get(args.config, f"unknown config {args.config!r}; "
                                      f"choose from {sorted(templates)}"))
 
@@ -366,7 +384,7 @@ def cmd_scaffold(args):
 def cmd_shell(args):
     from .shell.shell import run_shell
 
-    run_shell(args.master)
+    run_shell(args.master, args.filer)
 
 
 def cmd_version(args):
@@ -388,6 +406,10 @@ def main(argv=None):
 
     p = argparse.ArgumentParser(prog="seaweedfs_tpu")
     glog.add_flags(p)  # global flags, before the subcommand (as in weed)
+    p.add_argument("-cpuprofile", default="",
+                   help="write a CPU profile (cProfile stats) on exit")
+    p.add_argument("-memprofile", default="",
+                   help="write a memory profile (tracemalloc top) on exit")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master", help="run a master server")
@@ -559,6 +581,8 @@ def main(argv=None):
 
     sh = sub.add_parser("shell", help="admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("-filer", default="",
+                    help="filer url for fs.*/bucket.*/fsck commands")
     sh.set_defaults(fn=cmd_shell)
 
     ver = sub.add_parser("version")
@@ -566,6 +590,10 @@ def main(argv=None):
 
     args = p.parse_args(argv)
     glog.init_from_flags(args)
+    if args.cpuprofile or args.memprofile:
+        from .util.profiling import setup_profiling
+
+        setup_profiling(args.cpuprofile, args.memprofile)
     args.fn(args)
 
 

@@ -220,6 +220,16 @@ class FilerServer:
             return 404, {"error": "not found"}
         return 200, v
 
+    def _h_ui(self, h, path, q, body):
+        """Embedded status page (server/filer_ui analog)."""
+        from .status_ui import render_status_page
+
+        _, status = self._h_status(h, path, q, body)
+        h.extra_headers = {"Content-Type": "text/html; charset=utf-8"}
+        return 200, render_status_page(
+            f"seaweedfs_tpu filer {self.url}", {"Filer": status}
+        )
+
     def _h_status(self, h, path, q, body):
         return 200, {
             "signature": self.signature,
@@ -576,6 +586,9 @@ class FilerServer:
                 ("GET", "/_assign", fs._h_assign),
                 ("GET", "/_meta/events", fs._h_meta_events),
                 ("GET", "/_meta/watch", fs._h_meta_watch),
+                # _-prefixed like the other filer-internal routes: a bare
+                # /ui would shadow user files stored under that prefix
+                ("GET", "/_ui", fs._h_ui),
                 ("GET", "/_status", fs._h_status),
                 ("GET", "/metrics", fs._h_metrics),
                 ("POST", "/_query", fs._h_query),

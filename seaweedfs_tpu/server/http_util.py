@@ -49,6 +49,8 @@ class JsonHandler(BaseHTTPRequestHandler):
         else:
             data = json.dumps(payload).encode()
             ctype = "application/json"
+        if self.extra_headers and "Content-Type" in self.extra_headers:
+            ctype = self.extra_headers.pop("Content-Type")
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))

@@ -246,6 +246,26 @@ class MasterServer:
             "topology": self.master.topology_info(),
         }
 
+    def _h_ui(self, h, path, q, body):
+        """Embedded status page (server/master_ui analog)."""
+        from .status_ui import render_status_page
+
+        h.extra_headers = {"Content-Type": "text/html; charset=utf-8"}
+        return 200, render_status_page(
+            f"seaweedfs_tpu master {self.url}",
+            {
+                "Cluster": {
+                    "leader": self.election.leader,
+                    "is_leader": self.election.is_leader,
+                    "term": self.election.term,
+                    "peers": ", ".join(self.election.peers),
+                    "volume_size_limit": self.master.topo.volume_size_limit,
+                    "max_volume_id": self.master.topo.max_volume_id,
+                },
+                "Topology": self.master.topology_info(),
+            },
+        )
+
     def _h_ping(self, h, path, q, body):
         return 200, {"ok": True, "url": self.url}
 
@@ -333,6 +353,7 @@ class MasterServer:
                 ("GET", "/cluster/ping", ms._h_ping),
                 ("POST", "/cluster/leader_beat", ms._h_leader_beat),
                 ("POST", "/cluster/vote", ms._h_vote),
+                ("GET", "/ui", ms._h_ui),
                 ("GET", "/dir/status", ms._h_status),
                 ("GET", "/cluster/status", ms._h_status),
             ]

@@ -1,0 +1,153 @@
+"""TOML config layer with env override — `weed/util/config.go` (viper) analog.
+
+Search path matches the reference: `.`, `$HOME/.seaweedfs_tpu`,
+`/etc/seaweedfs` (`util/config.go` LoadConfiguration). Values resolve in
+priority order:
+
+1. `WEED_`-prefixed environment variables (viper AutomaticEnv): the key
+   `jwt.signing.key` maps to `WEED_JWT_SIGNING_KEY`.
+2. The TOML file `<name>.toml` from the first search-path hit.
+3. The caller's default.
+
+`weed scaffold -config=<name>` prints starter templates.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any, Optional
+
+from . import glog
+
+SEARCH_PATHS = [".", os.path.expanduser("~/.seaweedfs_tpu"), "/etc/seaweedfs"]
+
+
+class Configuration:
+    def __init__(self, data: dict, name: str, path: str = ""):
+        self._data = data
+        self._name = name
+        self.path = path
+
+    def get(self, key: str, default: Any = None) -> Any:
+        """Dotted key with WEED_ env override (viper semantics)."""
+        env = "WEED_" + key.upper().replace(".", "_").replace("-", "_")
+        if env in os.environ:
+            return os.environ[env]
+        node: Any = self._data
+        for part in key.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key, default)
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "yes", "on")
+        return bool(v)
+
+    def sub(self, prefix: str) -> dict:
+        """The raw table under a prefix (e.g. 'mysql')."""
+        v = self.get(prefix, {})
+        return v if isinstance(v, dict) else {}
+
+
+def load_configuration(
+    name: str,
+    required: bool = False,
+    search_paths: Optional[list[str]] = None,
+) -> Configuration:
+    for d in search_paths or SEARCH_PATHS:
+        path = os.path.join(d, f"{name}.toml")
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    data = tomllib.load(f)
+            except (OSError, tomllib.TOMLDecodeError) as e:
+                glog.error("config %s unreadable: %s", path, e)
+                if required:
+                    raise
+                continue
+            glog.V(1).info("loaded %s", path)
+            return Configuration(data, name, path)
+    if required:
+        raise FileNotFoundError(
+            f"{name}.toml not found in {search_paths or SEARCH_PATHS}"
+        )
+    return Configuration({}, name)
+
+
+SCAFFOLDS = {
+    "security": """\
+# security.toml — put in ., ~/.seaweedfs_tpu, or /etc/seaweedfs
+# (reference: weed scaffold -config=security → security.toml)
+
+[jwt.signing]
+# shared secret: volume servers verify fid-scoped write JWTs minted by the
+# master when this is non-empty
+key = ""
+expires_after_seconds = 10
+
+[jwt.signing.read]
+key = ""
+
+[guard]
+# ip whitelist for admin/write surfaces; empty = allow all
+white_list = []
+""",
+    "master": """\
+# master.toml
+
+[master.volume_growth]
+# how many volumes to grow per type when one fills
+copy_1 = 7
+copy_2 = 6
+copy_3 = 3
+
+[master.maintenance]
+garbage_threshold = 0.3
+""",
+    "filer": """\
+# filer.toml — filer store selection (first enabled store wins)
+
+[sqlite]
+enabled = true
+dbFile = "./filer.db"
+
+[memory]
+enabled = false
+
+[redis]
+enabled = false
+address = "localhost:6379"
+database = 0
+""",
+    "replication": """\
+# replication.toml — sink for weed filer.replicate
+
+[sink.local]
+enabled = false
+directory = "/backup"
+
+[sink.filer]
+enabled = false
+grpcAddress = "localhost:8888"
+
+[sink.s3]
+enabled = false
+endpoint = "http://127.0.0.1:8333"
+bucket = "mirror"
+""",
+    "notification": """\
+# notification.toml — filer event bus
+
+[notification.log]
+enabled = true
+
+[notification.kafka]
+enabled = false
+hosts = ["kafka1:9092"]
+topic = "seaweedfs_filer"
+""",
+}

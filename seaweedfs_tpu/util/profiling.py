@@ -1,0 +1,83 @@
+"""Profiling hooks — the `util/grace/pprof.go:11` (SetupProfiling) analog.
+
+The reference wires `-cpuprofile` / `-memprofile` flags into pprof file
+dumps flushed on shutdown. Here: cProfile for CPU (readable with
+`python -m pstats` or snakeviz), tracemalloc for memory, both dumped at
+process exit (and on SIGTERM, which the grace package also hooks).
+"""
+
+from __future__ import annotations
+
+import atexit
+import signal
+from typing import Optional
+
+from . import glog
+
+_cpu_profiler = None
+_mem_path: Optional[str] = None
+
+
+def setup_profiling(
+    cpu_profile_path: str = "", mem_profile_path: str = ""
+) -> None:
+    global _cpu_profiler, _mem_path
+    if cpu_profile_path and _cpu_profiler is None:
+        import cProfile
+
+        _cpu_profiler = cProfile.Profile()
+        _cpu_profiler.enable()
+        atexit.register(_dump_cpu, cpu_profile_path)
+        glog.info("cpu profiling on → %s", cpu_profile_path)
+    if mem_profile_path and _mem_path is None:
+        import tracemalloc
+
+        tracemalloc.start(10)
+        _mem_path = mem_profile_path
+        atexit.register(_dump_mem, mem_profile_path)
+        glog.info("memory profiling on → %s", mem_profile_path)
+    if cpu_profile_path or mem_profile_path:
+        _hook_sigterm()
+
+
+def _dump_cpu(path: str) -> None:
+    global _cpu_profiler
+    if _cpu_profiler is None:
+        return
+    _cpu_profiler.disable()
+    _cpu_profiler.dump_stats(path)
+    _cpu_profiler = None
+    glog.info("cpu profile written to %s", path)
+
+
+def _dump_mem(path: str) -> None:
+    global _mem_path
+    if _mem_path is None:
+        return
+    import tracemalloc
+
+    snap = tracemalloc.take_snapshot()
+    with open(path, "w") as f:
+        for stat in snap.statistics("lineno")[:200]:
+            f.write(f"{stat}\n")
+    _mem_path = None
+    glog.info("memory profile written to %s", path)
+
+
+def _hook_sigterm() -> None:
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def handler(signum, frame):
+        import atexit as _atexit
+
+        glog.flush()
+        _atexit._run_exitfuncs()
+        if callable(prev):
+            prev(signum, frame)
+        else:
+            raise SystemExit(143)
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:
+        pass  # not the main thread

@@ -1,0 +1,95 @@
+"""TOML config layer (util/config.go analog), status UIs, profiling hooks."""
+
+import os
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.util.config import (
+    SCAFFOLDS,
+    Configuration,
+    load_configuration,
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_load_search_path_and_dotted_keys(tmp_path):
+    (tmp_path / "security.toml").write_text(SCAFFOLDS["security"])
+    conf = load_configuration("security", search_paths=[str(tmp_path)])
+    assert conf.path.endswith("security.toml")
+    assert conf.get("jwt.signing.key") == ""
+    assert conf.get("jwt.signing.expires_after_seconds") == 10
+    assert conf.get("guard.white_list") == []
+    assert conf.get("missing.key", "fallback") == "fallback"
+
+
+def test_env_override_wins(tmp_path, monkeypatch):
+    (tmp_path / "filer.toml").write_text(SCAFFOLDS["filer"])
+    conf = load_configuration("filer", search_paths=[str(tmp_path)])
+    assert conf.get("sqlite.dbFile") == "./filer.db"
+    monkeypatch.setenv("WEED_SQLITE_DBFILE", "/elsewhere.db")
+    assert conf.get("sqlite.dbFile") == "/elsewhere.db"
+    # env also reaches keys with no file at all
+    monkeypatch.setenv("WEED_REDIS_ADDRESS", "r:6379")
+    empty = load_configuration("nothere", search_paths=[str(tmp_path)])
+    assert empty.get("redis.address") == "r:6379"
+
+
+def test_get_bool_and_required(tmp_path):
+    (tmp_path / "filer.toml").write_text(SCAFFOLDS["filer"])
+    conf = load_configuration("filer", search_paths=[str(tmp_path)])
+    assert conf.get_bool("sqlite.enabled") is True
+    assert conf.get_bool("memory.enabled") is False
+    with pytest.raises(FileNotFoundError):
+        load_configuration("absent", required=True,
+                           search_paths=[str(tmp_path)])
+    # all scaffold templates parse
+    for name in SCAFFOLDS:
+        (tmp_path / f"{name}.toml").write_text(SCAFFOLDS[name])
+        load_configuration(name, required=True, search_paths=[str(tmp_path)])
+
+
+def test_status_ui_pages(tmp_path):
+    from seaweedfs_tpu.server.http_util import http_bytes_headers
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    m = MasterServer(port=free_port()).start()
+    vs = VolumeServer(
+        [str(tmp_path / "v")], port=free_port(), master_url=m.url,
+        pulse_seconds=0.5,
+    ).start()
+    time.sleep(0.3)
+    try:
+        st, body, hdrs = http_bytes_headers("GET", f"http://{m.url}/ui")
+        assert st == 200
+        assert "text/html" in hdrs.get("Content-Type", "")
+        assert b"seaweedfs_tpu master" in body and b"Topology" in body
+        st, body, hdrs = http_bytes_headers(
+            "GET", f"http://{vs.host}:{vs.port}/ui"
+        )
+        assert st == 200 and b"volume server" in body
+    finally:
+        vs.stop()
+        m.stop()
+
+
+def test_profiling_writes_stats(tmp_path):
+    import seaweedfs_tpu.util.profiling as prof
+
+    cpu = str(tmp_path / "cpu.prof")
+    prof.setup_profiling(cpu_profile_path=cpu)
+    sum(i * i for i in range(10000))  # some work to profile
+    prof._dump_cpu(cpu)
+    assert os.path.getsize(cpu) > 0
+    import pstats
+
+    pstats.Stats(cpu)  # parseable
