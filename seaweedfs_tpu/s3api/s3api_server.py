@@ -14,6 +14,7 @@ SigV2) and authorized per identity action grants (`auth_credentials.go:124`).
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 import urllib.parse
 import uuid
@@ -22,6 +23,8 @@ from http.server import BaseHTTPRequestHandler
 
 from ..server.http_util import start_server
 from . import auth as s3auth
+from . import policy_engine as pe
+from . import post_policy as pp
 from .auth import IAM
 from ..filer.client import FilerClient
 from .xml_util import error_xml, find_text, findall, parse_xml, to_xml
@@ -43,6 +46,7 @@ _ERR_STATUS = {
     "InvalidPart": 400,
     "BucketAlreadyExists": 409,
     "BucketNotEmpty": 409,
+    "NoSuchBucketPolicy": 404,
     "InternalError": 500,
 }
 
@@ -64,6 +68,9 @@ class S3ApiServer:
         self.host, self.port = host, port
         self.client = FilerClient(filer_url)
         self.iam = iam or IAM()
+        self._policy_cache: dict = {}  # bucket → (BucketPolicy | None,)
+        self._policy_lock = threading.Lock()  # handler threads race the cache
+        self._policy_gen: dict = {}  # bucket → invalidation generation
         self._srv = None
 
     # ---------------------------------------------------------------- helpers
@@ -108,6 +115,12 @@ class S3ApiServer:
         if not self._bucket_exists(bucket):
             return _err("NoSuchBucket", bucket)
         self.client.delete(self._bucket_dir(bucket), recursive=True)
+        # the policy dies with the bucket — a recreated namesake must not
+        # inherit the old grants
+        self.client.delete(f"{self.POLICIES_DIR}/{bucket}")
+        with self._policy_lock:
+            self._policy_gen[bucket] = self._policy_gen.get(bucket, 0) + 1
+            self._policy_cache.pop(bucket, None)
         return 204, b""
 
     # ------------------------------------------------------------ list objects
@@ -305,7 +318,7 @@ class S3ApiServer:
         self.client.delete(path)
         return 204, b""
 
-    def _delete_multiple(self, bucket, body):
+    def _delete_multiple(self, bucket, body, can_delete=None):
         try:
             root = parse_xml(body)
         except Exception:
@@ -314,6 +327,9 @@ class S3ApiServer:
         for obj in findall(root, "Object"):
             key = find_text(obj, "Key")
             if not key:
+                continue
+            if can_delete is not None and not can_delete(key):
+                errors.append({"Key": key, "Code": "AccessDenied"})
                 continue
             status, _ = self._delete_object(bucket, key)
             if status in (200, 204):
@@ -519,20 +535,241 @@ class S3ApiServer:
             {"Bucket": bucket, "Upload": uploads},
         )
 
+    # -------------------------------------------------------- post-policy
+    def _post_policy_upload(self, bucket, headers, body):
+        """Browser form upload with a signed policy
+        (s3api_object_handlers_postpolicy.go:20). Auth lives inside the
+        form, not the request headers."""
+        try:
+            values, file_bytes, file_name = pp.parse_multipart_form(
+                body, headers.get("Content-Type", "")
+            )
+        except (ValueError, FileNotFoundError) as e:
+            return _err("MalformedPOSTRequest", f"/{bucket}", str(e))
+        values["bucket"] = bucket
+        key = values.get("key", "")
+        if not key:
+            return _err("MalformedPOSTRequest", f"/{bucket}", "no key field")
+        if "${filename}" in key:
+            key = key.replace("${filename}", file_name)
+            values["key"] = key
+
+        identity = None
+        access_key = ""
+        signed = (
+            "signature" in values
+            or "x-amz-signature" in values
+            or values.get("policy")
+        )
+        if self.iam.enabled and signed:
+            def secret_for(ak):
+                ident = self.iam._by_key.get(ak)
+                return ident.secret_key if ident else None
+
+            if "signature" in values:  # SignV2 form
+                ak = pp.verify_policy_signature_v2(values, secret_for)
+            else:
+                ak = pp.verify_policy_signature_v4(values, secret_for)
+            if ak is None:
+                return _err("SignatureDoesNotMatch", f"/{bucket}/{key}")
+            identity = self.iam._by_key[ak]
+            access_key = ak
+        # the bucket policy governs form POSTs too: explicit Deny wins on
+        # every write path, and an Allow admits principals (incl. anonymous)
+        # beyond their identity grant list
+        pol = self._bucket_policy(bucket)
+        verdict = None
+        if pol is not None:
+            verdict = pe.evaluate(
+                pol, access_key, "s3:PutObject", pe.arn(bucket, key)
+            )
+        if verdict is False:
+            return _err("AccessDenied", f"/{bucket}/{key}")
+        if self.iam.enabled and verdict is not True:
+            if identity is None:  # unsigned form, no policy Allow
+                return _err("AccessDenied", f"/{bucket}/{key}")
+            if not identity.can_do(s3auth.ACTION_WRITE, bucket):
+                return _err("AccessDenied", f"/{bucket}/{key}")
+        if values.get("policy"):
+            try:
+                policy = pp.parse_post_policy(pp.decode_policy(values))
+                pp.check_post_policy(values, policy)
+            except ValueError as e:
+                return _err(
+                    "PostPolicyInvalidCondition", f"/{bucket}/{key}", str(e)
+                )
+            if policy.length_min >= 0 and len(file_bytes) < policy.length_min:
+                return _err("EntityTooSmall", f"/{bucket}/{key}")
+            if 0 <= policy.length_max < len(file_bytes):
+                return _err("EntityTooLarge", f"/{bucket}/{key}")
+        elif identity is not None:
+            # authenticated posts must carry a policy (the signature signs it)
+            return _err("MalformedPOSTRequest", f"/{bucket}/{key}", "no policy")
+
+        ctype = values.get("content-type", "application/octet-stream")
+        res = self._put_object(
+            bucket, key, {"Content-Type": ctype}, file_bytes
+        )
+        status = res[0]
+        if status not in (200, 201):
+            return res
+        # advertise the same ETag a later GET/HEAD will serve
+        etag = (res[2].get("ETag", "") if len(res) == 3 else "").strip('"')
+        etag = etag or hashlib.md5(file_bytes).hexdigest()
+        redirect = values.get("success_action_redirect", "")
+        if redirect:
+            sep = "&" if "?" in redirect else "?"
+            loc = f"{redirect}{sep}bucket={bucket}&key=" + urllib.parse.quote(
+                key
+            ) + f"&etag=%22{etag}%22"
+            return 303, b"", {"Location": loc}
+        want_status = values.get("success_action_status", "204")
+        if want_status == "201":
+            return 201, to_xml(
+                "PostResponse",
+                {
+                    "Location": f"/{bucket}/{key}",
+                    "Bucket": bucket,
+                    "Key": key,
+                    "ETag": f'"{etag}"',
+                },
+            )
+        return (200, b"") if want_status == "200" else (204, b"")
+
+    # -------------------------------------------------------- bucket policy
+    # Stored under /etc (like the reference's s3 config subtree), NOT under
+    # /buckets — a policy document must never be addressable as an object,
+    # or a plain Write grant could rewrite any bucket's policy.
+    POLICIES_DIR = "/etc/s3/policies"
+
+    def _bucket_policy(self, bucket):
+        """Cached parse of the bucket's policy document (None = no policy)."""
+        with self._policy_lock:
+            cached = self._policy_cache.get(bucket)
+            gen = self._policy_gen.get(bucket, 0)
+        if cached is not None:
+            return cached[0]
+        status, data, _ = self.client.get_object(
+            f"{self.POLICIES_DIR}/{bucket}"
+        )
+        pol = None
+        if status == 200 and data:
+            try:
+                pol = pe.parse_bucket_policy(data)
+            except (ValueError, KeyError):
+                pol = None
+        with self._policy_lock:
+            if self._policy_gen.get(bucket, 0) != gen:
+                return pol  # invalidated mid-read: serve but don't cache
+            while len(self._policy_cache) >= 1024:  # bound negative entries
+                self._policy_cache.pop(next(iter(self._policy_cache)))
+            self._policy_cache[bucket] = (pol,)
+        return pol
+
+    def _put_bucket_policy(self, bucket, body):
+        if not self._bucket_exists(bucket):
+            return _err("NoSuchBucket", bucket)
+        try:
+            pe.parse_bucket_policy(body)
+        except (ValueError, KeyError) as e:
+            return _err("MalformedPolicy", bucket, str(e))
+        self.client.put_object(f"{self.POLICIES_DIR}/{bucket}", body)
+        with self._policy_lock:
+            self._policy_gen[bucket] = self._policy_gen.get(bucket, 0) + 1
+            self._policy_cache.pop(bucket, None)
+        return 204, b""
+
+    def _get_bucket_policy(self, bucket):
+        if not self._bucket_exists(bucket):
+            return _err("NoSuchBucket", bucket)
+        status, data, _ = self.client.get_object(
+            f"{self.POLICIES_DIR}/{bucket}"
+        )
+        if status != 200 or not data:
+            return _err("NoSuchBucketPolicy", bucket)
+        return 200, data, {"Content-Type": "application/json"}
+
+    def _delete_bucket_policy(self, bucket):
+        if not self._bucket_exists(bucket):
+            return _err("NoSuchBucket", bucket)
+        self.client.delete(f"{self.POLICIES_DIR}/{bucket}")
+        with self._policy_lock:
+            self._policy_gen[bucket] = self._policy_gen.get(bucket, 0) + 1
+            self._policy_cache.pop(bucket, None)
+        return 204, b""
+
     # ------------------------------------------------------------------ router
     def handle(self, method, raw_path, query, headers, body):
+        path_probe = urllib.parse.unquote(raw_path).lstrip("/")
+        if (
+            method == "POST"
+            and path_probe
+            and not path_probe.startswith(".")
+            and "/" not in path_probe.rstrip("/")
+            and headers.get("Content-Type", "").startswith(
+                "multipart/form-data"
+            )
+        ):
+            # bucket-level form POST: auth is in the form, not the headers
+            # (s3api_server.go:101 routes these before the auth wrapper)
+            return self._post_policy_upload(
+                path_probe.rstrip("/"), headers, body
+            )
         identity, err = self.iam.authenticate(
             method, raw_path, query, headers, body
         )
-        if err:
+        # an unsigned request is not an auth *failure* — it falls through as
+        # anonymous so a bucket policy with Principal "*" can admit it
+        # (public buckets). Bad signatures still hard-fail.
+        anonymous = (
+            err == "AccessDenied"
+            and not headers.get("Authorization")
+            and "X-Amz-Algorithm" not in query
+            and "Signature" not in query
+        )
+        if err and not anonymous:
             return _err(err, raw_path)
         path = urllib.parse.unquote(raw_path)
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0] if parts[0] else ""
         key = parts[1] if len(parts) > 1 else ""
+        if bucket.startswith("."):
+            # dot-prefixed names would collide with the gateway's internal
+            # dirs under /buckets (.uploads); S3 names start alphanumeric
+            return _err("InvalidBucketName", path)
 
-        def allowed(action):
+        def allowed(action, s3_action="", obj_key=None):
+            # resource policy first (explicit Deny wins, Allow grants even
+            # beyond the identity grant list), then identity grants.
+            # s3_action picks the exact policy action name when the coarse
+            # gate is ambiguous (s3:DeleteObject vs s3:PutObject, …).
+            if bucket:
+                pol = self._bucket_policy(bucket)
+                if pol is not None:
+                    who = identity.access_key if identity else ""
+                    name = s3_action or pe.ACTION_NAMES.get(action, "s3:*")
+                    k = key if obj_key is None else obj_key
+                    res = pe.arn(bucket, k) if k else pe.arn(bucket)
+                    verdict = pe.evaluate(pol, who, name, res)
+                    if verdict is not None:
+                        return verdict
+            if anonymous:
+                return False  # only an explicit policy Allow admits anonymous
             return identity is None or identity.can_do(action, bucket)
+
+        # ?policy subresource (PutBucketPolicy / GetBucketPolicy / Delete)
+        if bucket and not key and "policy" in query:
+            if self.iam.enabled and (
+                identity is None
+                or not identity.can_do(s3auth.ACTION_ADMIN, bucket)
+            ):
+                return _err("AccessDenied", path)
+            if method == "PUT":
+                return self._put_bucket_policy(bucket, body)
+            if method == "GET":
+                return self._get_bucket_policy(bucket)
+            if method == "DELETE":
+                return self._delete_bucket_policy(bucket)
 
         if not bucket:
             if method == "GET":
@@ -543,19 +780,27 @@ class S3ApiServer:
 
         if not key:
             if method == "PUT":
-                if not allowed(s3auth.ACTION_ADMIN):
+                if not allowed(s3auth.ACTION_ADMIN, "s3:CreateBucket"):
                     return _err("AccessDenied", path)
                 return self._put_bucket(bucket)
             if method == "HEAD":
+                if not allowed(s3auth.ACTION_READ, "s3:ListBucket"):
+                    return _err("AccessDenied", path)
                 return self._head_bucket(bucket)
             if method == "DELETE":
-                if not allowed(s3auth.ACTION_ADMIN):
+                if not allowed(s3auth.ACTION_ADMIN, "s3:DeleteBucket"):
                     return _err("AccessDenied", path)
                 return self._delete_bucket(bucket)
             if method == "POST" and "delete" in query:
-                if not allowed(s3auth.ACTION_WRITE):
-                    return _err("AccessDenied", path)
-                return self._delete_multiple(bucket, body)
+                # per-key policy evaluation — an object-scoped Deny must
+                # cover the batch path exactly like single DELETEs
+                return self._delete_multiple(
+                    bucket,
+                    body,
+                    can_delete=lambda k: allowed(
+                        s3auth.ACTION_WRITE, "s3:DeleteObject", obj_key=k
+                    ),
+                )
             if method == "GET":
                 if not allowed(s3auth.ACTION_LIST):
                     return _err("AccessDenied", path)
@@ -570,7 +815,12 @@ class S3ApiServer:
 
         # object-level
         if "tagging" in query:
-            if not allowed(s3auth.ACTION_TAGGING):
+            tag_action = {
+                "GET": "s3:GetObjectTagging",
+                "PUT": "s3:PutObjectTagging",
+                "DELETE": "s3:DeleteObjectTagging",
+            }.get(method, "s3:PutObjectTagging")
+            if not allowed(s3auth.ACTION_TAGGING, tag_action):
                 return _err("AccessDenied", path)
             if method == "GET":
                 return self._get_tagging(bucket, key)
@@ -591,10 +841,12 @@ class S3ApiServer:
                 return _err("AccessDenied", path)
             return self._upload_part(bucket, key, query, body, headers)
         if method == "DELETE" and "uploadId" in query:
-            if not allowed(s3auth.ACTION_WRITE):
+            if not allowed(s3auth.ACTION_WRITE, "s3:AbortMultipartUpload"):
                 return _err("AccessDenied", path)
             return self._abort_multipart(bucket, key, query)
         if method == "GET" and "uploadId" in query:
+            if not allowed(s3auth.ACTION_READ, "s3:ListMultipartUploadParts"):
+                return _err("AccessDenied", path)
             return self._list_parts(bucket, key, query)
         if method == "PUT":
             if not allowed(s3auth.ACTION_WRITE):
@@ -605,7 +857,7 @@ class S3ApiServer:
                 return _err("AccessDenied", path)
             return self._get_object(bucket, key, headers, head=(method == "HEAD"))
         if method == "DELETE":
-            if not allowed(s3auth.ACTION_WRITE):
+            if not allowed(s3auth.ACTION_WRITE, "s3:DeleteObject"):
                 return _err("AccessDenied", path)
             return self._delete_object(bucket, key)
         return _err("MethodNotAllowed", path)
@@ -682,6 +934,6 @@ class S3ApiServer:
         return f"{self.host}:{self.port}"
 
 
-def _err(code: str, resource: str):
+def _err(code: str, resource: str, message: str = ""):
     status = _ERR_STATUS.get(code, 400)
-    return status, error_xml(code, code, resource)
+    return status, error_xml(code, message or code, resource)
