@@ -55,13 +55,27 @@ def upload_data(
     mime: str = "",
     ttl: str = "",
     jwt: str = "",
+    compress: bool = True,
 ) -> dict:
     import urllib.request
+
+    # client-side auto-gzip by file type (upload_content.go:107-136); the
+    # volume server stores the compressed bytes with FLAG_IS_COMPRESSED
+    gzipped = False
+    if compress:
+        from .util import compression
+
+        if compression.should_gzip(name, mime, data):
+            gz = compression.maybe_gzip_data(data)
+            if gz is not data:  # identity means it didn't pay off
+                data, gzipped = gz, True
 
     q = f"?ttl={ttl}" if ttl else ""
     req = urllib.request.Request(
         f"http://{url}/{fid}{q}", data=data, method="POST"
     )
+    if gzipped:
+        req.add_header("Content-Encoding", "gzip")
     if name:
         req.add_header("X-Sweed-Name", name)
     if mime:

@@ -110,18 +110,30 @@ def http_json(
 
 
 def http_bytes(
-    method: str, url: str, body: Optional[bytes] = None, timeout: float = 30.0
+    method: str,
+    url: str,
+    body: Optional[bytes] = None,
+    timeout: float = 30.0,
+    headers: Optional[dict] = None,
 ) -> tuple[int, bytes]:
-    status, data, _ = http_bytes_headers(method, url, body=body, timeout=timeout)
+    status, data, _ = http_bytes_headers(
+        method, url, body=body, timeout=timeout, headers=headers
+    )
     return status, data
 
 
 def http_bytes_headers(
-    method: str, url: str, body: Optional[bytes] = None, timeout: float = 30.0
+    method: str,
+    url: str,
+    body: Optional[bytes] = None,
+    timeout: float = 30.0,
+    headers: Optional[dict] = None,
 ) -> tuple[int, bytes, dict]:
     """Like http_bytes but also returns response headers (some admin
     endpoints carry metadata such as X-Compaction-Revision there)."""
-    req = urllib.request.Request(url, data=body, method=method)
+    req = urllib.request.Request(
+        url, data=body, method=method, headers=headers or {}
+    )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, resp.read(), dict(resp.headers)

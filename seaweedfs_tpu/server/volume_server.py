@@ -168,6 +168,17 @@ class VolumeServer:
             if n.cookie != cookie:
                 return 404, {"error": "cookie mismatch"}
             data = bytes(n.data)
+            if n.is_compressed:
+                # serve gzip verbatim only to clients that asked for it;
+                # everyone else gets the original bytes
+                if "gzip" in h.headers.get("Accept-Encoding", "") and not (
+                    q.get("width") or q.get("height")
+                ):
+                    h.extra_headers = {"Content-Encoding": "gzip"}
+                else:
+                    from ..util.compression import ungzip_data
+
+                    data = ungzip_data(data)
             if q.get("width") or q.get("height"):
                 # on-read auto-resize for image needles (images/resizing.go)
                 from ..util import images
@@ -191,6 +202,12 @@ class VolumeServer:
         n = Needle(cookie=cookie, id=nid, data=bytes(body))
         name = h.headers.get("X-Sweed-Name")
         mime = h.headers.get("X-Sweed-Mime")
+        if h.headers.get("Content-Encoding") == "gzip":
+            # client pre-compressed (needle_parse_upload.go:75): store as-is,
+            # flag it so reads know to decompress
+            from ..storage.needle import FLAG_IS_COMPRESSED
+
+            n.set_flag(FLAG_IS_COMPRESSED)
         if name:
             n.name = name.encode()[:255]
             n.set_flag(FLAG_HAS_NAME)
@@ -240,6 +257,14 @@ class VolumeServer:
         r = http_json("GET", f"http://{self.master_url}/dir/lookup?volumeId={vid}")
         me = self.store.public_url
         errors = []
+        # forward needle metadata so replicas carry the same name/mime/
+        # compression flags as the primary (store_replicate.go keeps the
+        # original request intact on fan-out)
+        fwd = {
+            k: v
+            for k, v in h.headers.items()
+            if k.title() in ("X-Sweed-Name", "X-Sweed-Mime", "Content-Encoding")
+        }
         for loc in r.get("locations", []):
             url = loc["url"]
             if url == me or url == f"{self.host}:{self.port}":
@@ -260,7 +285,9 @@ class VolumeServer:
             full = f"http://{url}{path}?type=replicate" + (
                 f"&{extra}" if extra else ""
             )
-            status, resp = http_bytes(method, full, body if method == "POST" else None)
+            status, resp = http_bytes(
+                method, full, body if method == "POST" else None, headers=fwd
+            )
             if status >= 300:
                 errors.append(f"{url}: {status} {resp[:100]!r}")
         return "; ".join(errors) if errors else None
@@ -382,7 +409,7 @@ class VolumeServer:
         v = self.store.find_volume(int(q["volume"]))
         if v is None:
             return 404, {"error": "volume not found"}
-        v.compact()
+        v.compact(bytes_per_second=int(q.get("compactionBytePerSecond", 0)))
         return 200, {"size": v.size()}
 
     # -- admin: EC (volume_grpc_erasure_coding.go) ---------------------------

@@ -575,7 +575,7 @@ class Volume:
             os.unlink(self.tier_file())
 
     # -- vacuum / compaction (volume_vacuum.go) ------------------------------
-    def compact(self) -> None:
+    def compact(self, bytes_per_second: int = 0) -> None:
         """Concurrent compaction: snapshot-scan live needles to .cpd/.cpx
         WITHOUT the write lock, then take the lock only to replay the delta
         and swap files — the reference's `Compact2` + `makeupDiff`
@@ -587,9 +587,16 @@ class Volume:
         Safe because both logs are append-only: bytes below the snapshot
         sizes are immutable, so the unlocked scan reads a consistent
         point-in-time state.
+
+        `bytes_per_second` paces the unlocked bulk copy (the reference's
+        compactionBytePerSecond throttle) so maintenance IO doesn't starve
+        the data plane; 0 = unthrottled.
         """
         from . import idx as idx_mod
+        from ..util.throttler import WriteThrottler
         from .types import needle_map_entry_size
+
+        throttler = WriteThrottler(bytes_per_second)
 
         with self._lock:
             if self._is_compacting:
@@ -656,6 +663,7 @@ class Volume:
                             )
                         )
                         new_offset += total
+                        throttler.maybe_slowdown(total)
                     offset += total
                 # phase 3 (locked): makeupDiff — replay .idx entries
                 # appended during phases 1-2, then swap

@@ -1,0 +1,177 @@
+"""Transparent gzip storage + write throttling.
+
+Reference: `weed/util/compression.go` (MaybeGzipData, IsCompressableFileType),
+`weed/operation/upload_content.go:107-136` (upload-side decision),
+`weed/storage/needle/needle_parse_upload.go:75` (FLAG_IS_COMPRESSED),
+`weed/util/throttler.go` (WriteThrottler pacing compaction).
+"""
+
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.util import compression
+from seaweedfs_tpu.util.throttler import WriteThrottler
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------------ unit
+def test_compressible_file_type_table():
+    assert compression.is_compressible_file_type(".txt", "") == (True, True)
+    assert compression.is_compressible_file_type("", "text/plain") == (True, True)
+    assert compression.is_compressible_file_type(".jpg", "") == (False, True)
+    assert compression.is_compressible_file_type(".gz", "") == (False, True)
+    assert compression.is_compressible_file_type("", "image/png") == (False, True)
+    assert compression.is_compressible_file_type("", "application/json") == (True, True)
+    assert compression.is_compressible_file_type("", "application/zip") == (False, True)
+    assert compression.is_compressible_file_type(".bin", "") == (False, False)
+
+
+def test_maybe_gzip_roundtrip_and_pay_off():
+    text = b"the quick brown fox jumps over the lazy dog " * 100
+    gz = compression.maybe_gzip_data(text)
+    assert compression.is_gzipped_content(gz) and len(gz) < len(text)
+    assert compression.ungzip_data(gz) == text
+    # already-gzipped data is not double-compressed
+    assert compression.maybe_gzip_data(gz) == gz
+    # incompressible data passes through
+    import os as _os
+
+    noise = _os.urandom(4096)
+    assert compression.maybe_gzip_data(noise) == noise
+    assert compression.maybe_decompress(noise) == noise
+
+
+def test_should_gzip_decision():
+    text = b"compressible text content, highly repetitive. " * 50
+    assert compression.should_gzip("notes.txt", "", text)
+    assert compression.should_gzip("", "text/html", text)
+    assert not compression.should_gzip("photo.jpg", "", text)
+    # no verdict + no mime → 128-byte probe
+    assert compression.should_gzip("", "", text)
+    import os as _os
+
+    assert not compression.should_gzip("", "", _os.urandom(4096))
+    # tiny payloads are never worth it
+    assert not compression.should_gzip("a.txt", "", b"hi")
+
+
+# ------------------------------------------------------------------ e2e
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("gz")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    v1 = VolumeServer(
+        [str(tmp / "v1")], port=free_port(), master_url=master.url,
+        max_volume_count=10, pulse_seconds=0.5,
+    ).start()
+    v2 = VolumeServer(
+        [str(tmp / "v2")], port=free_port(), master_url=master.url,
+        max_volume_count=10, pulse_seconds=0.5,
+    ).start()
+    time.sleep(0.8)
+    yield master
+    v2.stop()
+    v1.stop()
+    master.stop()
+
+
+def test_upload_text_stored_gzipped_served_plain(cluster):
+    body = b"log line: something happened at tick %d\n" * 200
+    a = operation.assign(cluster.url)
+    operation.upload_data(a.url, a.fid, body, name="app.log", mime="text/plain")
+    # plain client gets the original bytes back
+    got = operation.download(cluster.url, a.fid)
+    assert got == body
+    # a gzip-capable client gets the stored compressed form + header
+    req = urllib.request.Request(f"http://{a.url}/{a.fid}")
+    req.add_header("Accept-Encoding", "gzip")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        raw = resp.read()
+        assert resp.headers.get("Content-Encoding") == "gzip"
+    assert compression.is_gzipped_content(raw)
+    assert compression.ungzip_data(raw) == body
+    assert len(raw) < len(body)  # it really is stored compressed
+
+
+def test_upload_jpeg_not_compressed(cluster):
+    body = bytes(range(256)) * 64
+    a = operation.assign(cluster.url)
+    operation.upload_data(a.url, a.fid, body, name="x.jpg", mime="image/jpeg")
+    req = urllib.request.Request(f"http://{a.url}/{a.fid}")
+    req.add_header("Accept-Encoding", "gzip")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        raw = resp.read()
+        assert resp.headers.get("Content-Encoding") is None
+    assert raw == body
+
+
+def test_replicas_carry_compression_and_name(cluster):
+    """Replica fan-out forwards X-Sweed-*/Content-Encoding, so every copy
+    has the same flags as the primary."""
+    body = b"replicated text payload, repeated enough to gzip well. " * 100
+    a = operation.assign(cluster.url, replication="001")
+    operation.upload_data(
+        a.url, a.fid, body, name="r.txt", mime="text/plain", jwt=a.auth
+    )
+    locs = operation.lookup(cluster.url, int(a.fid.split(",")[0]))
+    assert len(locs) == 2
+    for loc in locs:
+        req = urllib.request.Request(f"http://{loc['url']}/{a.fid}")
+        req.add_header("Accept-Encoding", "gzip")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            raw = resp.read()
+            assert resp.headers.get("Content-Encoding") == "gzip", loc
+        assert compression.ungzip_data(raw) == body
+
+
+# ------------------------------------------------------------------ throttle
+def test_write_throttler_paces():
+    t = WriteThrottler(bytes_per_second=1_000_000)
+    t0 = time.monotonic()
+    sent = 0
+    while sent < 500_000:
+        t.maybe_slowdown(50_000)
+        sent += 50_000
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.3  # 0.5MB at 1MB/s ≈ 0.5s (allow scheduler slack)
+    # unthrottled is effectively instant
+    t = WriteThrottler(0)
+    t0 = time.monotonic()
+    for _ in range(100):
+        t.maybe_slowdown(10_000_000)
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_throttled_compaction(tmp_path):
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), collection="", vid=7)
+    from seaweedfs_tpu.storage.needle import Needle
+
+    for i in range(1, 60):
+        n = Needle(cookie=1, id=i, data=b"x" * 8192)
+        v.write_needle(n)
+    for i in range(1, 30):
+        v.delete_needle(Needle(cookie=1, id=i))
+    t0 = time.monotonic()
+    v.compact(bytes_per_second=400_000)  # ~240KB live → >=0.3s at 400KB/s
+    throttled = time.monotonic() - t0
+    assert throttled >= 0.2
+    # data intact after throttled compaction
+    n = Needle(id=45)
+    v.read_needle(n)
+    assert bytes(n.data) == b"x" * 8192
+    v.close()
