@@ -104,9 +104,32 @@ def cmd_filer(args):
     # filer.toml store selection (first enabled store wins); explicit -db
     # beats the config file
     db_path = args.db
+    store = None
     conf = load_configuration("filer")
-    if db_path == ":memory:" and conf.get_bool("sqlite.enabled"):
-        db_path = conf.get("sqlite.dbFile", "./filer.db")
+    if db_path == ":memory:":
+        if conf.get_bool("redis.enabled"):
+            from .filer.redis_store import RedisStore
+
+            store = RedisStore(
+                address=conf.get("redis.address", "127.0.0.1:6379"),
+                password=conf.get("redis.password", ""),
+                database=int(conf.get("redis.database", 0) or 0),
+            )
+        elif conf.get_bool("sql.enabled"):
+            from .filer.abstract_sql import GenericSqlStore
+
+            kwargs = {
+                k: v
+                for k, v in conf.sub("sql").items()
+                if k not in ("enabled", "driver", "dialect")
+            }
+            store = GenericSqlStore(
+                conf.get("sql.driver"),
+                dialect=conf.get("sql.dialect", ""),
+                **kwargs,
+            )
+        elif conf.get_bool("sqlite.enabled"):
+            db_path = conf.get("sqlite.dbFile", "./filer.db")
     fs = FilerServer(
         host=args.ip,
         port=args.port,
@@ -119,6 +142,7 @@ def cmd_filer(args):
         peers=[p for p in args.peers.split(",") if p],
         meta_log_dir=args.meta_log_dir,
         jwt_signing_key=_security_conf()["jwt_signing_key"],
+        store=store,
     ).start()
     print(f"filer on {fs.url} → master {args.master}")
     _wait_forever()

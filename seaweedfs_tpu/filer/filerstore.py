@@ -1,18 +1,18 @@
 """FilerStore plugins: the uniform KV/SQL adapter interface.
 
 Mirrors `weed/filer/filerstore.go:20`: insert/update/find/delete/
-delete_folder_children/list + KV. Two implementations:
+delete_folder_children/list + KV. Implementations:
 
-- MemoryStore: dict-backed (tests, scratch)
-- SqliteStore: stdlib sqlite3 standing in for the reference's leveldb
-  default and abstract_sql stores (same dirhash+name keying scheme as
-  `abstract_sql/abstract_sql_store.go`)
+- MemoryStore (here): dict-backed (tests, scratch)
+- SqliteStore / AbstractSqlStore / GenericSqlStore (abstract_sql.py):
+  the SQL family, embedded sqlite by default, any DB-API driver by name
+  (`abstract_sql/abstract_sql_store.go`)
+- RedisStore (redis_store.py): redis-protocol networked store
+  (`redis2/universal_redis_store.go`)
 """
 
 from __future__ import annotations
 
-import json
-import sqlite3
 import threading
 from typing import Iterator, Optional
 
@@ -117,92 +117,12 @@ class MemoryStore(FilerStore):
         return self._kv.get(key)
 
 
-class SqliteStore(FilerStore):
-    """Entries keyed (dir, name) like abstract_sql; JSON meta blob."""
+def __getattr__(name):
+    # SqliteStore/AbstractSqlStore live in abstract_sql (which imports this
+    # module for the base class); resolve lazily to avoid the cycle while
+    # keeping `from .filerstore import SqliteStore` working everywhere
+    if name in ("SqliteStore", "AbstractSqlStore", "GenericSqlStore"):
+        from . import abstract_sql
 
-    def __init__(self, db_path: str = ":memory:"):
-        self._db = sqlite3.connect(db_path, check_same_thread=False)
-        self._lock = threading.RLock()
-        with self._lock:
-            self._db.execute(
-                "CREATE TABLE IF NOT EXISTS filemeta ("
-                " dir TEXT NOT NULL, name TEXT NOT NULL, meta TEXT NOT NULL,"
-                " PRIMARY KEY (dir, name))"
-            )
-            self._db.execute(
-                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)"
-            )
-            self._db.commit()
-
-    @staticmethod
-    def _split(path: str) -> tuple[str, str]:
-        path = _norm(path)
-        if path == "/":
-            return "", "/"
-        d, _, name = path.rpartition("/")
-        return d or "/", name
-
-    def insert_entry(self, entry: Entry) -> None:
-        d, name = self._split(entry.full_path)
-        with self._lock:
-            self._db.execute(
-                "INSERT OR REPLACE INTO filemeta (dir, name, meta) VALUES (?,?,?)",
-                (d, name, json.dumps(entry.to_dict())),
-            )
-            self._db.commit()
-
-    update_entry = insert_entry
-
-    def find_entry(self, path: str) -> Entry:
-        d, name = self._split(path)
-        with self._lock:
-            row = self._db.execute(
-                "SELECT meta FROM filemeta WHERE dir=? AND name=?", (d, name)
-            ).fetchone()
-        if row is None:
-            raise NotFoundError(path)
-        return Entry.from_dict(json.loads(row[0]))
-
-    def delete_entry(self, path: str) -> None:
-        d, name = self._split(path)
-        with self._lock:
-            self._db.execute(
-                "DELETE FROM filemeta WHERE dir=? AND name=?", (d, name)
-            )
-            self._db.commit()
-
-    def delete_folder_children(self, path: str) -> None:
-        p = _norm(path)
-        with self._lock:
-            self._db.execute("DELETE FROM filemeta WHERE dir=?", (p,))
-            self._db.execute(
-                "DELETE FROM filemeta WHERE dir LIKE ?", (p.rstrip("/") + "/%",)
-            )
-            self._db.commit()
-
-    def list_entries(self, dir_path: str, start_after: str = "", limit: int = 1000):
-        d = _norm(dir_path)
-        with self._lock:
-            rows = self._db.execute(
-                "SELECT meta FROM filemeta WHERE dir=? AND name>? "
-                "ORDER BY name LIMIT ?",
-                (d, start_after, limit),
-            ).fetchall()
-        for (meta,) in rows:
-            yield Entry.from_dict(json.loads(meta))
-
-    def kv_put(self, key: bytes, value: bytes) -> None:
-        with self._lock:
-            self._db.execute(
-                "INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)", (key, value)
-            )
-            self._db.commit()
-
-    def kv_get(self, key: bytes) -> Optional[bytes]:
-        with self._lock:
-            row = self._db.execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
-        return row[0] if row else None
-
-    def close(self) -> None:
-        with self._lock:
-            self._db.close()
+        return getattr(abstract_sql, name)
+    raise AttributeError(name)

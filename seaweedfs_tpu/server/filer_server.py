@@ -76,6 +76,7 @@ class FilerServer:
         manifest_batch: int = 1000,
         peers: Optional[list[str]] = None,
         meta_log_dir: str = "",
+        store=None,
     ):
         from ..stats import default_registry
         from ..util.chunk_cache import TieredChunkCache
@@ -101,8 +102,13 @@ class FilerServer:
             # store (a supported topology) must not interleave segments or
             # collide on seq numbering in a common directory
             meta_log_dir = f"{db_path}.metalog.{port}"
+        elif not meta_log_dir and store is not None:
+            # networked store (redis/sql): the store is durable, so the meta
+            # log must be too — peers resume from offsets saved in the store's
+            # KV, which would dangle against a fresh in-memory log
+            meta_log_dir = f"./filer.metalog.{port}"
         self.filer = Filer(
-            store=SqliteStore(db_path),
+            store=store or SqliteStore(db_path),
             chunk_purger=self._purge_chunks,
             meta_log_dir=meta_log_dir or None,
         )

@@ -121,7 +121,14 @@ enabled = false
 [redis]
 enabled = false
 address = "localhost:6379"
+password = ""
 database = 0
+
+[sql]
+# any DB-API 2.0 driver importable by name (mysql/postgres clients);
+# remaining keys in this table are passed to driver.connect(**kwargs)
+enabled = false
+driver = "pymysql"
 """,
     "replication": """\
 # replication.toml — sink for weed filer.replicate

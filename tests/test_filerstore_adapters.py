@@ -1,0 +1,333 @@
+"""Networked FilerStore adapters: redis-protocol store + generic DB-API SQL.
+
+Reference: `weed/filer/redis2/universal_redis_store.go` (entry-per-key +
+sorted-set dir listings), `weed/filer/abstract_sql/abstract_sql_store.go`
+(dir/name-keyed meta table shared by every SQL dialect). The mini RESP
+server (`util/mini_redis.py`) stands in for an external redis the way
+sqlite stands in for an external SQL database.
+"""
+
+import sqlite3
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.abstract_sql import AbstractSqlStore, GenericSqlStore
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.filer.filerstore import (
+    MemoryStore,
+    NotFoundError,
+    SqliteStore,
+)
+from seaweedfs_tpu.filer.redis_store import RedisStore, RespClient, RespError
+from seaweedfs_tpu.util.mini_redis import MiniRedisServer
+
+
+@pytest.fixture(scope="module")
+def redis_server():
+    srv = MiniRedisServer().start()
+    yield srv
+    srv.stop()
+
+
+class _FormatParamConn:
+    """Fake 'format'-paramstyle DB-API connection over sqlite3 — proves the
+    abstract store emits dialect-correct placeholders for mysql/postgres
+    style drivers, not just qmark."""
+
+    paramstyle = "format"
+
+    def __init__(self):
+        self._db = sqlite3.connect(":memory:", check_same_thread=False)
+
+    def cursor(self):
+        conn = self
+
+        class _Cur:
+            def execute(self, sql, params=()):
+                self._c = conn._db.execute(sql.replace("%s", "?"), params)
+                return self._c
+
+            def fetchone(self):
+                return self._c.fetchone()
+
+            def fetchall(self):
+                return self._c.fetchall()
+
+        return _Cur()
+
+    def commit(self):
+        self._db.commit()
+
+    def close(self):
+        self._db.close()
+
+
+def _stores(redis_srv):
+    return {
+        "memory": MemoryStore(),
+        "sqlite": SqliteStore(),
+        "format-sql": AbstractSqlStore(_FormatParamConn(), paramstyle="format"),
+        "redis": RedisStore(redis_srv.address),
+    }
+
+
+@pytest.fixture(params=["memory", "sqlite", "format-sql", "redis"])
+def store(request, redis_server):
+    s = _stores(redis_server)[request.param]
+    if isinstance(s, RedisStore):
+        s._client.execute("FLUSHDB")
+    yield s
+    s.close()
+
+
+def test_contract_crud_listing_kv(store):
+    store.insert_entry(Entry(full_path="/d", is_directory=True))
+    for name in ("b.txt", "a.txt", "c.txt"):
+        store.insert_entry(Entry(full_path=f"/d/{name}"))
+    store.insert_entry(Entry(full_path="/d/sub", is_directory=True))
+    store.insert_entry(Entry(full_path="/d/sub/deep.txt"))
+
+    assert store.find_entry("/d/a.txt").name == "a.txt"
+    assert [e.name for e in store.list_entries("/d")] == [
+        "a.txt", "b.txt", "c.txt", "sub",
+    ]
+    assert [e.name for e in store.list_entries("/d", start_after="b.txt")] == [
+        "c.txt", "sub",
+    ]
+    assert [e.name for e in store.list_entries("/d", limit=2)] == [
+        "a.txt", "b.txt",
+    ]
+
+    # update visible
+    e = store.find_entry("/d/a.txt")
+    e.mime = "text/plain"
+    store.update_entry(e)
+    assert store.find_entry("/d/a.txt").mime == "text/plain"
+
+    store.delete_entry("/d/a.txt")
+    with pytest.raises(NotFoundError):
+        store.find_entry("/d/a.txt")
+
+    # recursive folder wipe reaches nested children
+    store.delete_folder_children("/d")
+    assert list(store.list_entries("/d")) == []
+    with pytest.raises(NotFoundError):
+        store.find_entry("/d/sub/deep.txt")
+
+    store.kv_put(b"offset", b"\x00\x01\x02")
+    assert store.kv_get(b"offset") == b"\x00\x01\x02"
+    assert store.kv_get(b"missing") is None
+
+
+def test_contract_deep_paging(store):
+    store.insert_entry(Entry(full_path="/big", is_directory=True))
+    names = [f"f{i:04d}" for i in range(250)]
+    for n in names:
+        store.insert_entry(Entry(full_path=f"/big/{n}"))
+    got, after = [], ""
+    while True:
+        page = [e.name for e in store.list_entries("/big", start_after=after, limit=100)]
+        if not page:
+            break
+        got += page
+        after = page[-1]
+    assert got == sorted(names)
+
+
+# ------------------------------------------------------------------ RESP wire
+def test_resp_client_primitives(redis_server):
+    c = RespClient(redis_server.address)
+    assert c.execute("PING") == "PONG"
+    c.execute("SET", b"bin\x00key", b"bin\x01value")
+    assert c.execute("GET", b"bin\x00key") == b"bin\x01value"
+    assert c.execute("GET", "nope") is None
+    assert c.execute("DEL", b"bin\x00key") == 1
+    c.execute("ZADD", "z", 0, "alpha", 0, "beta", 0, "gamma")
+    assert c.execute("ZRANGEBYLEX", "z", b"(alpha", b"+", "LIMIT", 0, 10) == [
+        b"beta", b"gamma",
+    ]
+    with pytest.raises(RespError):
+        c.execute("NOSUCHCMD")
+    c.close()
+
+
+def test_resp_auth():
+    srv = MiniRedisServer(password="sekret").start()
+    try:
+        with pytest.raises(RespError):
+            RespClient(srv.address).execute("GET", "x")
+        c = RespClient(srv.address, password="sekret")
+        assert c.execute("PING") == "PONG"
+        with pytest.raises(RespError):
+            RespClient(srv.address, password="wrong")
+    finally:
+        srv.stop()
+
+
+def test_redis_entry_ttl(redis_server):
+    store = RedisStore(redis_server.address)
+    store._client.execute("FLUSHDB")
+    store.insert_entry(Entry(full_path="/t", is_directory=True))
+    e = Entry(full_path="/t/tmp.txt")
+    e.ttl_sec = 1
+    store.insert_entry(e)
+    assert store.find_entry("/t/tmp.txt").name == "tmp.txt"
+    time.sleep(1.2)
+    with pytest.raises(NotFoundError):
+        store.find_entry("/t/tmp.txt")
+    # the stale dir member is dropped on the next listing
+    assert [x.name for x in store.list_entries("/t")] == []
+
+
+def test_sql_dialects_emit_correct_statements():
+    """mysql gets REPLACE INTO + sized key columns; postgres gets
+    ON CONFLICT + BYTEA — not sqlite's INSERT OR REPLACE / BLOB."""
+
+    class _Recorder:
+        paramstyle = "format"
+
+        def __init__(self):
+            self.sql = []
+            self._db = sqlite3.connect(":memory:", check_same_thread=False)
+
+        def cursor(self):
+            rec = self
+
+            class _Cur:
+                def execute(self, sql, params=()):
+                    rec.sql.append(sql)
+                    # translate to sqlite so the store still functions
+                    s = (
+                        sql.replace("%s", "?")
+                        .replace("REPLACE INTO", "INSERT OR REPLACE INTO")
+                        .replace("LONGTEXT", "TEXT")
+                        .replace("VARBINARY(512)", "BLOB")
+                        .replace("LONGBLOB", "BLOB")
+                        .replace("VARCHAR(766)", "TEXT")
+                        .replace("VARCHAR(250)", "TEXT")
+                    )
+                    self._c = rec._db.execute(s, params)
+                    return self._c
+
+                def fetchone(self):
+                    return self._c.fetchone()
+
+                def fetchall(self):
+                    return self._c.fetchall()
+
+            return _Cur()
+
+        def commit(self):
+            self._db.commit()
+
+        def close(self):
+            self._db.close()
+
+    rec = _Recorder()
+    s = AbstractSqlStore(rec, paramstyle="format", dialect="mysql")
+    s.insert_entry(Entry(full_path="/m/x"))
+    assert any(sql.startswith("REPLACE INTO filemeta") for sql in rec.sql)
+    assert any("VARCHAR(766)" in sql for sql in rec.sql)
+    assert not any("INSERT OR REPLACE" in sql for sql in rec.sql)
+    assert s.find_entry("/m/x").name == "x"
+
+    # postgres flavor: checked textually (no postgres server in the image)
+    from seaweedfs_tpu.filer.abstract_sql import _DIALECTS
+
+    tmpl = _DIALECTS["postgres"][2]
+    up = tmpl.format(table="filemeta", cols="dir, name, meta", ph="%s,%s,%s",
+                     pk="dir, name", assign="meta = EXCLUDED.meta")
+    assert "ON CONFLICT (dir, name) DO UPDATE SET meta = EXCLUDED.meta" in up
+    assert "BYTEA" in _DIALECTS["postgres"][1]
+
+
+def test_unsupported_paramstyle_and_dialect_rejected():
+    with pytest.raises(ValueError, match="paramstyle"):
+        AbstractSqlStore(_FormatParamConn(), paramstyle="named")
+    with pytest.raises(ValueError, match="dialect"):
+        AbstractSqlStore(_FormatParamConn(), dialect="oracle")
+
+
+def test_dialect_guess():
+    from seaweedfs_tpu.filer.abstract_sql import _guess_dialect
+
+    assert _guess_dialect("pymysql") == "mysql"
+    assert _guess_dialect("MySQLdb") == "mysql"
+    assert _guess_dialect("mariadb") == "mysql"
+    assert _guess_dialect("psycopg2") == "postgres"
+    assert _guess_dialect("pg8000") == "postgres"
+    assert _guess_dialect("sqlite3") == "sqlite"
+
+
+def test_resp_client_bare_hostname_defaults_port(monkeypatch):
+    import socket as _socket
+
+    seen = {}
+
+    def fake_connect(addr, timeout=None):
+        seen["addr"] = addr
+        raise ConnectionRefusedError  # stop before any IO
+
+    monkeypatch.setattr(_socket, "create_connection", fake_connect)
+    with pytest.raises(ConnectionRefusedError):
+        RespClient("somehost")
+    assert seen["addr"] == ("somehost", 6379)
+
+
+def test_generic_sql_store_by_driver_name():
+    s = GenericSqlStore("sqlite3", database=":memory:")
+    s.insert_entry(Entry(full_path="/g", is_directory=True))
+    s.insert_entry(Entry(full_path="/g/x.bin"))
+    assert s.find_entry("/g/x.bin").name == "x.bin"
+    s.close()
+
+
+# ------------------------------------------------------------------ filer e2e
+def test_two_filers_share_redis_store(redis_server, tmp_path):
+    """Two filer daemons over one redis: a write through A is visible
+    through B — the shared-store topology the reference supports with its
+    networked stores."""
+    import socket as _socket
+
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.http_util import http_bytes
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp_path / "v")], port=free_port(), master_url=master.url,
+        max_volume_count=10, pulse_seconds=0.5,
+    ).start()
+    sa = RedisStore(redis_server.address)
+    sa._client.execute("FLUSHDB")
+    fa = FilerServer(
+        port=free_port(), master_url=master.url, store=sa,
+        meta_log_dir=str(tmp_path / "mlA"),
+    ).start()
+    fb = FilerServer(
+        port=free_port(), master_url=master.url,
+        store=RedisStore(redis_server.address),
+        meta_log_dir=str(tmp_path / "mlB"),
+    ).start()
+    time.sleep(0.6)
+    try:
+        status, _ = http_bytes(
+            "POST", f"http://{fa.url}/shared/hello.txt", b"written via A"
+        )
+        assert status in (200, 201)
+        status, body = http_bytes("GET", f"http://{fb.url}/shared/hello.txt")
+        assert status == 200 and body == b"written via A"
+    finally:
+        fb.stop()
+        fa.stop()
+        volume.stop()
+        master.stop()
