@@ -144,6 +144,13 @@ def cmd_filer(args):
         jwt_signing_key=_security_conf()["jwt_signing_key"],
         store=store,
     ).start()
+    # notification.toml → publish meta events to the configured queue
+    from .replication import NotificationBus, make_queue
+
+    q = make_queue(load_configuration("notification"))
+    if q is not None:
+        NotificationBus(fs.filer).add_queue(q)
+        print(f"notifications → {type(q).__name__}")
     print(f"filer on {fs.url} → master {args.master}")
     _wait_forever()
 
@@ -317,13 +324,22 @@ def cmd_filer_sync(args):
 def cmd_filer_replicate(args):
     from .filer.client import FilerClient
     from .replication import LocalFsSink, Replicator, S3Sink
+    from .util import glog
 
     src = FilerClient(args.filer)
     if args.sink_s3:
         endpoint, bucket = args.sink_s3.rsplit("/", 1)
         sink = S3Sink(endpoint, bucket, args.s3_access_key, args.s3_secret_key)
     else:
-        sink = LocalFsSink(args.sink_dir)
+        # replication.toml picks the sink (incl. gcs/backblaze/azure);
+        # fall back to the -sink.dir local directory
+        from .replication import make_sink
+        from .util.config import load_configuration
+
+        try:
+            sink = make_sink(load_configuration("replication"))
+        except ValueError:
+            sink = LocalFsSink(args.sink_dir)
     repl = Replicator(
         sink,
         read_content=lambda p: src.get_object(p)[1],
@@ -334,7 +350,22 @@ def cmd_filer_replicate(args):
     while True:
         resp = src.meta_events(since_ns=offset)
         for ev in resp.get("events", []):
-            repl.replicate(ev)
+            # a flaky sink must not kill the daemon: retry with backoff,
+            # then skip the event (repl_util.go RetriedWriteFile)
+            for attempt in range(3):
+                try:
+                    repl.replicate(ev)
+                    break
+                except Exception as e:  # noqa: BLE001
+                    glog.warning(
+                        "replicate %s attempt %d failed: %s",
+                        (ev.get("new_entry") or ev.get("old_entry") or {})
+                        .get("full_path", "?"),
+                        attempt + 1,
+                        e,
+                    )
+                    if attempt < 2:  # no pointless sleep after the last try
+                        time.sleep(2**attempt)
             offset = ev["ts_ns"]
         if not resp.get("events"):
             time.sleep(1.0)

@@ -2,11 +2,12 @@
 `weed/notification/`, `weed/command/filer_sync.go`).
 
 - `sink`: ReplicationSink implementations — another filer cluster, an
-  S3-compatible endpoint, or a local directory (stand-in for the
-  GCS/Azure/B2 cloud sinks, which differ only in SDK plumbing).
+  S3-compatible endpoint, or a local directory.
+- `cloud_sinks`: GCS (XML interop), Backblaze B2 (S3 API), Azure Blob
+  (native SharedKey REST) + the replication.toml sink factory.
 - `replicator`: maps filer meta events (create/update/delete) to sink calls.
-- `notification`: pluggable queues publishing filer meta events
-  (in-memory + JSONL file queue standing in for kafka/sqs/pubsub).
+- `notification`: pluggable queues publishing filer meta events — memory,
+  JSONL file, glog, webhook, native-SigV4 SQS, gated kafka/pubsub.
 - `filer_sync`: continuous active-active or active-passive sync between two
   filer clusters with signature-based loop prevention and offsets
   checkpointed in the target filer's KV store.
@@ -14,5 +15,14 @@
 
 from .replicator import Replicator  # noqa: F401
 from .sink import FilerSink, LocalFsSink, S3Sink  # noqa: F401
+from .cloud_sinks import AzureSink, B2Sink, GcsSink, make_sink  # noqa: F401
 from .filer_sync import FilerSync  # noqa: F401
-from .notification import FileQueue, MemoryQueue, NotificationBus  # noqa: F401
+from .notification import (  # noqa: F401
+    FileQueue,
+    LogQueue,
+    MemoryQueue,
+    NotificationBus,
+    SqsQueue,
+    WebhookQueue,
+    make_queue,
+)

@@ -125,7 +125,23 @@ class S3Sink(ReplicationSink):
     def create_entry(self, key, entry, data):
         if entry.get("is_directory"):
             return  # buckets are flat; directories are implicit
-        self.client.put_object(self.bucket, self._key(key), data or b"")
+        status, body, _ = self.client.put_object(
+            self.bucket, self._key(key), data or b""
+        )
+        if status >= 300:
+            # surface the failure — callers retry (repl_util.go); a silent
+            # drop here is an invisible hole in the mirror
+            raise RuntimeError(
+                f"s3 sink PUT {self.bucket}/{self._key(key)}: "
+                f"{status} {body[:120]!r}"
+            )
 
     def delete_entry(self, key, is_directory):
-        self.client.delete_object(self.bucket, self._key(key))
+        status, body, _ = self.client.delete_object(
+            self.bucket, self._key(key)
+        )
+        if status >= 300 and status != 404:
+            raise RuntimeError(
+                f"s3 sink DELETE {self.bucket}/{self._key(key)}: "
+                f"{status} {body[:120]!r}"
+            )

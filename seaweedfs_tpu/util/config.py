@@ -145,16 +145,60 @@ grpcAddress = "localhost:8888"
 enabled = false
 endpoint = "http://127.0.0.1:8333"
 bucket = "mirror"
+
+[sink.gcs]
+# GCS XML/interop API with HMAC keys
+enabled = false
+bucket = "mirror"
+access_key = ""
+secret_key = ""
+directory = ""
+
+[sink.backblaze]
+# B2 via its S3-compatible endpoint
+enabled = false
+bucket = "mirror"
+b2_account_id = ""
+b2_master_application_key = ""
+region = "us-west-004"
+
+[sink.azure]
+# native Blob REST with SharedKey signing
+enabled = false
+account_name = ""
+account_key = ""
+container = "mirror"
+directory = ""
 """,
     "notification": """\
-# notification.toml — filer event bus
+# notification.toml — filer event bus (first enabled queue wins)
 
 [notification.log]
 enabled = true
 
+[notification.file]
+enabled = false
+path = "./events.jsonl"
+
+[notification.webhook]
+enabled = false
+url = "http://127.0.0.1:9000/events"
+
+[notification.aws_sqs]
+enabled = false
+aws_access_key_id = ""
+aws_secret_access_key = ""
+region = "us-east-1"
+sqs_queue_url = ""
+
 [notification.kafka]
 enabled = false
 hosts = ["kafka1:9092"]
+topic = "seaweedfs_filer"
+
+[notification.google_pub_sub]
+enabled = false
+project_id = ""
 topic = "seaweedfs_filer"
 """,
 }
