@@ -69,6 +69,7 @@ def cmd_volume(args):
         data_center=args.data_center,
         rack=args.rack,
         max_volume_count=args.max,
+        pulse_seconds=args.pulse,
         ec_backend=args.ec_backend or None,
         needle_map_kind=args.index,
         jwt_signing_key=sec["jwt_signing_key"],
@@ -489,6 +490,16 @@ def main(argv=None):
     v.add_argument("-dataCenter", dest="data_center", default="DefaultDataCenter")
     v.add_argument("-rack", default="DefaultRack")
     v.add_argument("-max", type=int, default=7)
+    def _positive_pulse(s):
+        val = float(s)
+        if val < 0.1:
+            raise argparse.ArgumentTypeError(
+                "pulseSeconds must be >= 0.1 (0 would busy-spin the beat loop)"
+            )
+        return val
+
+    v.add_argument("-pulseSeconds", dest="pulse", type=_positive_pulse,
+                   default=5.0)
     v.add_argument("-index", default="dense",
                    choices=["memory", "dense", "sqlite", "sorted"],
                    help="needle map kind (weed volume -index memory|leveldb)")

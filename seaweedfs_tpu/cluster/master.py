@@ -101,6 +101,8 @@ class Master:
 
     def _handle_heartbeat_locked(self, dn: DataNode, hb: dict) -> dict:
         dn.last_seen = time.time()
+        if "pulse_seconds" in hb:
+            dn.pulse_seconds = float(hb["pulse_seconds"])
         if "max_file_key" in hb:
             self.sequencer.set_max(hb["max_file_key"])
         if "max_volume_count" in hb:

@@ -154,6 +154,7 @@ class DataNode(Node):
         self.volumes: dict[int, VolumeInfo] = {}
         self.ec_shards: dict[int, int] = {}  # vid → shard bit mask
         self.last_seen = 0.0
+        self.pulse_seconds = 5.0  # node-reported beat interval
 
     def is_data_node(self) -> bool:
         return True

@@ -322,7 +322,13 @@ class MasterServer:
             now = time.time()
             with self._lock:
                 for url, dn in list(self._nodes.items()):
-                    if now - dn.last_seen > self.node_timeout:
+                    # scale to the node's own reported pulse so a long
+                    # -pulseSeconds doesn't get a healthy node reaped
+                    timeout = max(
+                        self.node_timeout,
+                        2.5 * getattr(dn, "pulse_seconds", 5.0),
+                    )
+                    if now - dn.last_seen > timeout:
                         self.master.handle_node_disconnect(dn)
                         del self._nodes[url]
 

@@ -701,6 +701,9 @@ class VolumeServer:
         self.store.drain_deltas()
         hb = self.store.collect_heartbeat()
         hb["ec_shards"] = self.store.collect_ec_heartbeat()["ec_shards"]
+        # the master scales this node's liveness timeout to the pulse —
+        # a long pulse must not get a healthy node reaped between beats
+        hb["pulse_seconds"] = self.pulse_seconds
         self._send_beat(hb)
 
     def _delta_beat_once(self) -> None:

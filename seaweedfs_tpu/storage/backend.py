@@ -49,18 +49,40 @@ class DiskFile(BackendStorageFile):
         mode = "r+b" if os.path.exists(path) else ("w+b" if create else None)
         if mode is None:
             raise FileNotFoundError(path)
-        self._f = open(path, mode)
+        # unbuffered: every write() reaches the kernel before we ack, like
+        # Go's os.File — a kill -9 must not lose acknowledged needles
+        # (durability against power loss still needs fsync=true / sync())
+        self._f = open(path, mode, buffering=0)
         self._lock = threading.Lock()
 
     def read_at(self, offset: int, size: int) -> bytes:
+        # raw FileIO read/write are single syscalls and may be partial —
+        # loop until done (BufferedIO used to do this for us)
         with self._lock:
             self._f.seek(offset)
-            return self._f.read(size)
+            chunks = []
+            remaining = size
+            while remaining > 0:
+                b = self._f.read(remaining)
+                if not b:
+                    break  # EOF
+                chunks.append(b)
+                remaining -= len(b)
+            return b"".join(chunks)
 
     def write_at(self, offset: int, data: bytes) -> int:
         with self._lock:
             self._f.seek(offset)
-            return self._f.write(data)
+            view = memoryview(data)
+            written = 0
+            while written < len(data):
+                n = self._f.write(view[written:])
+                if not n:
+                    raise OSError(
+                        f"short write at {offset + written} in {self._path}"
+                    )
+                written += n
+            return written
 
     def truncate(self, size: int) -> None:
         with self._lock:

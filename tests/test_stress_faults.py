@@ -1,0 +1,322 @@
+"""Concurrency stress + fault injection (SURVEY §5.2/§5.3).
+
+The reference ships no race-detector CI of its own; SURVEY told us to add
+stress coverage anyway: many writers/readers/deleters against one volume,
+concurrent filer mutations, parallel S3 multipart parts, and a
+kill -9 of a volume-server daemon mid-traffic followed by restart
+recovery (torn-tail truncation, `weed/storage/volume_checking.go`).
+"""
+
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------- volume races
+def test_volume_concurrent_mixed_ops(tmp_path):
+    """8 threads × mixed write/read/delete; the survivor set must be exactly
+    readable and the rebuilt index must agree with the live map."""
+    v = Volume(str(tmp_path), collection="", vid=3)
+    n_threads, per_thread = 8, 60
+    deleted: set[int] = set()
+    errors: list = []
+    dlock = threading.Lock()
+
+    def worker(t):
+        rng = random.Random(t)
+        try:
+            for i in range(per_thread):
+                nid = t * 1000 + i
+                payload = bytes([t]) * rng.randint(1, 2048)
+                v.write_needle(Needle(cookie=7, id=nid, data=payload))
+                if rng.random() < 0.3:
+                    v.delete_needle(Needle(cookie=7, id=nid))
+                    with dlock:
+                        deleted.add(nid)
+                if rng.random() < 0.3:
+                    # read someone else's needle; tolerate not-found/deleted
+                    other = rng.randrange(n_threads) * 1000 + rng.randrange(
+                        per_thread
+                    )
+                    n = Needle(id=other)
+                    try:
+                        v.read_needle(n)
+                    except Exception:
+                        pass
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"t{t}: {type(e).__name__} {e}")
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    # every surviving needle is readable with the right bytes
+    for t in range(n_threads):
+        for i in range(per_thread):
+            nid = t * 1000 + i
+            n = Needle(id=nid)
+            if nid in deleted:
+                with pytest.raises(Exception):
+                    v.read_needle(n)
+            else:
+                v.read_needle(n)
+                assert bytes(n.data[:1]) == bytes([t])
+    live_count = v.file_count() - v.deleted_count()
+    v.close()
+    # a cold restart rebuilds the same view from disk
+    v2 = Volume(str(tmp_path), collection="", vid=3)
+    for t in range(n_threads):
+        nid = t * 1000
+        if nid not in deleted:
+            n = Needle(id=nid)
+            v2.read_needle(n)
+    assert v2.file_count() - v2.deleted_count() == live_count
+    v2.close()
+
+
+def test_volume_vacuum_under_concurrent_write_storm(tmp_path):
+    """Compaction racing a write storm loses nothing (Compact2+makeupDiff)."""
+    v = Volume(str(tmp_path), collection="", vid=4)
+    for i in range(1, 200):
+        v.write_needle(Needle(cookie=1, id=i, data=b"x" * 512))
+    for i in range(1, 100):
+        v.delete_needle(Needle(cookie=1, id=i))
+    stop = threading.Event()
+    written: list[int] = []
+    errors: list = []
+
+    def storm():
+        nid = 10_000
+        while not stop.is_set():
+            nid += 1
+            try:
+                v.write_needle(
+                    Needle(cookie=1, id=nid, data=os.urandom(256))
+                )
+                written.append(nid)
+            except Exception as e:  # noqa: BLE001
+                errors.append(str(e))
+
+    t = threading.Thread(target=storm)
+    t.start()
+    time.sleep(0.05)
+    v.compact()
+    stop.set()
+    t.join()
+    assert errors == []
+    assert written, "storm wrote nothing — test proves nothing"
+    for nid in written:
+        v.read_needle(Needle(id=nid))
+    with pytest.raises(Exception):
+        v.read_needle(Needle(id=50))  # vacuumed tombstone stays dead
+    v.close()
+
+
+# ------------------------------------------------------------- filer races
+def test_filer_concurrent_crud_and_listing():
+    from seaweedfs_tpu.filer.entry import Entry
+    from seaweedfs_tpu.filer.filer import Filer
+
+    f = Filer()
+    errors: list = []
+
+    def creator(t):
+        try:
+            for i in range(80):
+                f.create_entry(Entry(full_path=f"/race/d{t}/f{i}.txt"))
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"c{t}: {e}")
+
+    def lister():
+        try:
+            for _ in range(60):
+                list(f.list_entries("/race"))
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"l: {e}")
+
+    def deleter(t):
+        try:
+            for i in range(0, 80, 2):
+                try:
+                    f.delete_entry(f"/race/d{t}/f{i}.txt")
+                except KeyError:
+                    pass  # racing its own creator — not yet created is fine
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"d{t}: {e}")
+
+    threads = (
+        [threading.Thread(target=creator, args=(t,)) for t in range(4)]
+        + [threading.Thread(target=lister) for _ in range(2)]
+        + [threading.Thread(target=deleter, args=(t,)) for t in range(4)]
+    )
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    # deterministic survivors: odd-numbered files in every dir
+    for t in range(4):
+        names = {e.name for e in f.list_entries(f"/race/d{t}", limit=1000)}
+        assert {f"f{i}.txt" for i in range(1, 80, 2)} <= names
+
+
+# ------------------------------------------------------- s3 multipart race
+def test_s3_parallel_multipart_parts(tmp_path):
+    from seaweedfs_tpu.s3api import IAM, Identity, S3ApiServer
+    from seaweedfs_tpu.s3api.s3_client import S3Client
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp_path / "v")], port=free_port(), master_url=master.url,
+        max_volume_count=10, pulse_seconds=0.5,
+    ).start()
+    filer = FilerServer(port=free_port(), master_url=master.url).start()
+    iam = IAM([Identity("u", "AK", "SK", ["Admin", "Read", "Write", "List"])])
+    api = S3ApiServer(port=free_port(), filer_url=filer.url, iam=iam).start()
+    try:
+        time.sleep(0.5)
+        c = S3Client(f"http://{api.url}", "AK", "SK")
+        c.create_bucket("mp")
+        status, body, _ = c.request(
+            "POST", "/mp/big.bin", query={"uploads": ""}
+        )
+        assert status == 200
+        upload_id = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+        parts = {i: bytes([i]) * 65536 for i in range(1, 9)}
+        errs: list = []
+
+        def put_part(i):
+            st, b, _ = c.request(
+                "PUT", "/mp/big.bin",
+                query={"partNumber": str(i), "uploadId": upload_id},
+                body=parts[i],
+            )
+            if st != 200:
+                errs.append((i, st, b[:100]))
+
+        threads = [
+            threading.Thread(target=put_part, args=(i,)) for i in parts
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == []
+        complete = "<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{i}</PartNumber></Part>" for i in parts
+        ) + "</CompleteMultipartUpload>"
+        st, b, _ = c.request(
+            "POST", "/mp/big.bin", query={"uploadId": upload_id},
+            body=complete.encode(),
+        )
+        assert st == 200, b[:200]
+        st, data, _ = c.get_object("mp", "big.bin")
+        assert st == 200
+        assert data == b"".join(parts[i] for i in sorted(parts))
+    finally:
+        api.stop()
+        filer.stop()
+        volume.stop()
+        master.stop()
+
+
+# ----------------------------------------------------------- fault injection
+def test_volume_server_kill9_recovery(tmp_path):
+    """SIGKILL a volume-server daemon mid-traffic; after restart every
+    acked write must be readable (torn unacked tails are truncated away)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo_root)
+    mport, vport = free_port(), free_port()
+    master = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "master", "-port", str(mport)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    vdir = str(tmp_path / "v")
+    os.makedirs(vdir)
+
+    def start_volume():
+        return subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", "volume", "-dir", vdir,
+             "-port", str(vport), "-mserver", f"127.0.0.1:{mport}",
+             "-pulseSeconds", "1"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    vol = start_volume()
+    try:
+        time.sleep(2.5)
+        from seaweedfs_tpu import operation
+
+        acked = []
+        killed = threading.Event()
+
+        def writer():
+            i = 0
+            while not killed.is_set() and i < 500:
+                i += 1
+                try:
+                    a = operation.assign(f"127.0.0.1:{mport}")
+                    operation.upload_data(
+                        a.url, a.fid, f"payload-{i}".encode() * 50,
+                        jwt=a.auth, compress=False,
+                    )
+                    acked.append((a.fid, i))
+                except Exception:
+                    if killed.is_set():
+                        return
+                    time.sleep(0.05)
+
+        w = threading.Thread(target=writer)
+        w.start()
+        time.sleep(2.0)  # let a pile of acked writes accumulate
+        vol.send_signal(signal.SIGKILL)  # no flush, no goodbye
+        killed.set()
+        w.join()
+        vol.wait()
+        assert len(acked) >= 10, f"only {len(acked)} acked writes"
+        vol = start_volume()
+        time.sleep(2.5)
+        ok = 0
+        for fid, i in acked:
+            try:
+                data = operation.download(f"127.0.0.1:{mport}", fid)
+                assert data == f"payload-{i}".encode() * 50, fid
+                ok += 1
+            except RuntimeError as e:
+                # the last ack may have raced the KILL inside the socket
+                # buffer; anything older than that must survive
+                if (fid, i) != acked[-1]:
+                    raise AssertionError(f"acked write lost: {fid} ({e})")
+        assert ok >= len(acked) - 1
+    finally:
+        for p in (vol, master):
+            p.send_signal(signal.SIGTERM)
+        time.sleep(0.3)
+        for p in (vol, master):
+            p.kill()
