@@ -296,6 +296,23 @@ def cmd_webdav(args):
     _wait_forever()
 
 
+def cmd_ftp(args):
+    import json as _json
+
+    from .server.ftp_server import FtpServer
+
+    users = {}
+    if args.users:
+        with open(args.users) as f:
+            users = _json.load(f)
+    srv = FtpServer(
+        host=args.ip, port=args.port, filer_url=args.filer, root=args.root,
+        users=users,
+    ).start()
+    print(f"ftp on {srv.url} → filer {args.filer}")
+    _wait_forever()
+
+
 def cmd_msg_broker(args):
     from .messaging import Broker
 
@@ -594,6 +611,15 @@ def main(argv=None):
     wd.add_argument("-filer", default="127.0.0.1:8888")
     wd.add_argument("-root", default="/")
     wd.set_defaults(fn=cmd_webdav)
+
+    ftp = sub.add_parser("ftp", help="FTP gateway over a filer")
+    ftp.add_argument("-ip", default="127.0.0.1")
+    ftp.add_argument("-port", type=int, default=8021)
+    ftp.add_argument("-filer", default="127.0.0.1:8888")
+    ftp.add_argument("-root", default="/")
+    ftp.add_argument("-users", default="",
+                     help='JSON file {"user": "password"}; empty = anonymous')
+    ftp.set_defaults(fn=cmd_ftp)
 
     mb = sub.add_parser("msgBroker", help="pub/sub message broker")
     mb.add_argument("-ip", default="127.0.0.1")

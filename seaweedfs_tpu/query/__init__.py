@@ -3,3 +3,4 @@ volume_grpc_query.go:12` + `weed/query/json`): server-side filtering and
 projection of CSV / JSON-lines object content."""
 
 from .engine import run_query  # noqa: F401
+from .sql import parse_sql, run_sql  # noqa: F401

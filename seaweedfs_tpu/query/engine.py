@@ -38,6 +38,14 @@ def _coerce_pair(a: Any, b: Any) -> tuple[Any, Any]:
 def _matches(doc: dict, flt: Optional[dict]) -> bool:
     if not flt:
         return True
+    # compound filters from the SQL front-end: {"and": [...]} / {"or": [...]}
+    # / {"not": {...}} nest arbitrarily around leaf comparisons
+    if "and" in flt:
+        return all(_matches(doc, f) for f in flt["and"])
+    if "or" in flt:
+        return any(_matches(doc, f) for f in flt["or"])
+    if "not" in flt:
+        return not _matches(doc, flt["not"])
     got = _get_path(doc, flt.get("field", ""))
     op = flt.get("op", "=")
     want = flt.get("value")

@@ -265,13 +265,24 @@ class FilerServer:
         except NotFoundError:
             return 404, {"error": f"{target} not found"}
         data = self._read_range(entry, 0, entry.file_size())
-        rows = run_query(
-            data,
-            input_format=req.get("input", "json"),
-            select=req.get("select"),
-            where=req.get("where"),
-            limit=int(req.get("limit", 0)),
-        )
+        if req.get("sql"):
+            # S3-Select style: SELECT ... FROM s3object WHERE ... LIMIT n
+            from ..query.sql import SqlError, run_sql
+
+            try:
+                rows = run_sql(
+                    data, req["sql"], input_format=req.get("input", "json")
+                )
+            except SqlError as e:
+                return 400, {"error": f"bad sql: {e}"}
+        else:
+            rows = run_query(
+                data,
+                input_format=req.get("input", "json"),
+                select=req.get("select"),
+                where=req.get("where"),
+                limit=int(req.get("limit", 0)),
+            )
         return 200, {"rows": rows, "count": len(rows)}
 
     @staticmethod

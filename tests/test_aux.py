@@ -110,6 +110,77 @@ def test_query_csv():
     assert rows == [{"name": "gadget", "qty": "12"}]
 
 
+def test_query_compound_filters():
+    rows = run_query(DOCS, where={"and": [
+        {"field": "addr.city", "op": "=", "value": "ams"},
+        {"field": "age", "op": ">", "value": 35},
+    ]})
+    assert [r["name"] for r in rows] == ["carol"]
+    rows = run_query(DOCS, where={"or": [
+        {"field": "name", "op": "=", "value": "bob"},
+        {"not": {"field": "age", "op": "<", "value": 40}},
+    ]})
+    assert [r["name"] for r in rows] == ["bob", "carol"]
+
+
+# ----------------------------------------------------------------- SQL front
+def test_sql_select_where_limit():
+    from seaweedfs_tpu.query import run_sql
+
+    rows = run_sql(
+        DOCS, "SELECT name FROM s3object WHERE addr.city = 'ams' AND age > 35"
+    )
+    assert rows == [{"name": "carol"}]
+    rows = run_sql(DOCS, "select * from s3object where age >= 25 limit 2")
+    assert len(rows) == 2 and rows[0]["name"] == "alice"
+    rows = run_sql(
+        DOCS,
+        "SELECT name, age FROM s3object "
+        "WHERE (name = 'bob' OR name = 'carol') AND NOT age < 30",
+    )
+    assert rows == [{"name": "carol", "age": 40}]
+    rows = run_sql(DOCS, "SELECT name FROM s3object WHERE name LIKE 'car%'")
+    assert rows == [{"name": "carol"}]
+    rows = run_sql(DOCS, "SELECT name FROM s3object WHERE name LIKE '%aro%'")
+    assert rows == [{"name": "carol"}]
+    rows = run_sql(
+        b'{"msg": "it\'s here"}\n',
+        "SELECT msg FROM s3object WHERE msg = 'it\\'s here'",
+    )
+    assert rows == [{"msg": "it's here"}]
+
+
+def test_sql_csv_and_errors():
+    import pytest as _pytest
+
+    from seaweedfs_tpu.query import run_sql
+    from seaweedfs_tpu.query.sql import SqlError, parse_sql
+
+    data = b"name,qty\nwidget,5\ngadget,12\n"
+    rows = run_sql(
+        data, "SELECT name FROM s3object WHERE qty >= 10", input_format="csv"
+    )
+    assert rows == [{"name": "gadget"}]
+    select, where, limit = parse_sql(
+        "SELECT a, b FROM t WHERE x != 3 LIMIT 7"
+    )
+    assert select == ["a", "b"] and limit == 7
+    assert where == {"field": "x", "op": "!=", "value": 3}
+    assert parse_sql("SELECT * FROM t WHERE x <> 3")[1]["op"] == "!="
+    for bad in (
+        "SELECT FROM t",
+        "SELECT * FROM t WHERE",
+        "SELECT * FROM t WHERE x ~ 3",
+        "SELECT * FROM t WHERE x LIKE 'a_b'",
+        "SELECT * FROM t LIMIT 2 extra",
+        "SELECT * FROM t LIMIT 2.5",
+        "SELECT * FROM t LIMIT -5",
+        "DELETE FROM t",
+    ):
+        with _pytest.raises(SqlError):
+            parse_sql(bad)
+
+
 # ------------------------------------------------------------------ metrics
 def test_metrics_registry_exposition():
     reg = Registry()
