@@ -457,7 +457,7 @@ def cmd_scaffold(args):
 def cmd_shell(args):
     from .shell.shell import run_shell
 
-    run_shell(args.master, args.filer)
+    run_shell(args.master, args.filer, command=args.command)
 
 
 def cmd_version(args):
@@ -675,6 +675,8 @@ def main(argv=None):
     sh.add_argument("-master", default="127.0.0.1:9333")
     sh.add_argument("-filer", default="",
                     help="filer url for fs.*/bucket.*/fsck commands")
+    sh.add_argument("-c", dest="command", default="",
+                    help="run ;-separated commands and exit (non-interactive)")
     sh.set_defaults(fn=cmd_shell)
 
     ver = sub.add_parser("version")

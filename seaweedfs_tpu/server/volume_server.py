@@ -362,12 +362,13 @@ class VolumeServer:
         if v is None:
             return 404, {"error": "volume not found"}
         info = v.tier_upload(
-            q["endpoint"],
+            q.get("endpoint", ""),
             q["bucket"],
             access_key=q.get("accessKey", ""),
             secret_key=q.get("secretKey", ""),
             keep_local=q.get("keepLocal") == "true",
             skip_upload=q.get("skipUpload") == "true",
+            backend=q.get("backend", ""),
         )
         return 200, info
 

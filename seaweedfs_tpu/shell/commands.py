@@ -331,6 +331,7 @@ def volume_tier_upload(
     endpoint: str,
     bucket: str,
     keep_local: bool = False,
+    backend: str = "",
 ) -> dict:
     """Move a sealed volume's .dat to an S3-compatible tier
     (shell/command_volume_tier_upload.go)."""
@@ -347,7 +348,7 @@ def volume_tier_upload(
             "POST",
             f"http://{loc}/admin/tier_upload?volume={vid}&endpoint={endpoint}"
             f"&bucket={bucket}&keepLocal={'true' if keep_local else 'false'}"
-            f"&skipUpload={'true' if i > 0 else 'false'}",
+            f"&skipUpload={'true' if i > 0 else 'false'}&backend={backend}",
         )
         results.append({"server": loc} | r)
     return {"tiered": results}

@@ -118,8 +118,32 @@ def run_command(env: CommandEnv, line: str) -> object:
     return f"unknown command {cmd!r} (try help)"
 
 
-def run_shell(master: str, filer: str = "") -> None:
+def run_shell(master: str, filer: str = "", command: str = "") -> None:
     env = CommandEnv(master, filer=filer)
+    if command:
+        # one-shot mode (weed shell accepts piped commands the same way)
+        failed = False
+        try:
+            for line in command.split(";"):
+                try:
+                    result = run_command(env, line)
+                except EOFError:  # 'exit' in a script is a clean stop
+                    break
+                except Exception as e:  # noqa: BLE001
+                    print(f"error: {e}")
+                    failed = True
+                    continue
+                if result is not None:
+                    print(
+                        result
+                        if isinstance(result, str)
+                        else json.dumps(result, indent=2, default=str)
+                    )
+        finally:
+            env.unlock()  # never leak the cluster admin lock
+        if failed:
+            raise SystemExit(1)
+        return
     print(f"connected to master {master}; 'help' for commands")
     while True:
         try:

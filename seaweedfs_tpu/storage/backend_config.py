@@ -1,0 +1,44 @@
+"""Named remote-backend configuration for cloud tiering.
+
+The reference keeps S3 credentials in the master's `[storage.backend.s3.*]`
+config and volumes reference backends by name (`backend/s3_backend`,
+`volume_tier.go` — the .vif carries only the backend name + key). Round-1
+stored credentials inline in every `.tier` descriptor; this module closes
+that hole: descriptors carry `{"backend": "s3.default"}` and the secrets
+live only in `backend.toml` (searched in ., ~/.seaweedfs_tpu,
+/etc/seaweedfs — same paths as every other config, WEED_* env overrides
+apply):
+
+    [s3.default]
+    endpoint = "https://s3.us-east-1.amazonaws.com"
+    access_key = ""
+    secret_key = ""
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..util.config import Configuration, load_configuration
+
+
+class BackendConfigError(KeyError):
+    pass
+
+
+def resolve_backend(
+    name: str, conf: Optional[Configuration] = None
+) -> dict:
+    """Backend name ("s3.default") → {endpoint, access_key, secret_key}."""
+    conf = conf or load_configuration("backend")
+    endpoint = conf.get(f"{name}.endpoint")
+    if endpoint is None:
+        raise BackendConfigError(
+            f"backend {name!r} not defined in backend.toml "
+            f"(searched {conf.path or 'standard paths'})"
+        )
+    return {
+        "endpoint": endpoint,
+        "access_key": conf.get(f"{name}.access_key", "") or "",
+        "secret_key": conf.get(f"{name}.secret_key", "") or "",
+    }

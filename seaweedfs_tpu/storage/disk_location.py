@@ -72,6 +72,13 @@ class DiskLocation:
                                 ev.close()
                 except (ValueError, FileNotFoundError):
                     continue  # not a volume file
+                except KeyError as e:
+                    # e.g. a named tier backend missing from backend.toml —
+                    # skip that volume, don't take the whole server down
+                    from ..util import glog
+
+                    glog.error("skipping volume %s: %s", base, e)
+                    continue
 
     # -- volume management ---------------------------------------------------
     def add_volume(self, volume: Volume) -> None:

@@ -105,12 +105,13 @@ class Volume:
 
             with open(base + ".tier") as f:
                 info = _json.load(f)
+            endpoint, ak, sk = Volume._tier_credentials(info)
             self.data_backend: BackendStorageFile = RemoteS3File(
-                info["endpoint"],
+                endpoint,
                 info["bucket"],
                 info["key"],
-                info.get("access_key", ""),
-                info.get("secret_key", ""),
+                ak,
+                sk,
                 size=info["size"],
             )
             self.read_only = True
@@ -462,14 +463,31 @@ class Volume:
     def tier_file(self) -> str:
         return self.file_name() + ".tier"
 
+    @staticmethod
+    def _tier_credentials(info: dict) -> tuple[str, str, str]:
+        """.tier descriptor → (endpoint, access_key, secret_key); named
+        backends resolve through backend.toml, legacy descriptors carry
+        creds inline."""
+        if info.get("backend"):
+            from .backend_config import resolve_backend
+
+            bc = resolve_backend(info["backend"])
+            return bc["endpoint"], bc["access_key"], bc["secret_key"]
+        return (
+            info.get("endpoint", ""),
+            info.get("access_key", ""),
+            info.get("secret_key", ""),
+        )
+
     def tier_upload(
         self,
-        endpoint: str,
-        bucket: str,
+        endpoint: str = "",
+        bucket: str = "",
         access_key: str = "",
         secret_key: str = "",
         keep_local: bool = False,
         skip_upload: bool = False,
+        backend: str = "",
     ) -> dict:
         """Seal the volume and move its .dat to an S3-compatible backend,
         keeping .idx local; reads continue through ranged GETs
@@ -481,6 +499,18 @@ class Volume:
         from .backend import DiskFile, RemoteS3File
         from ..s3api.s3_client import S3Client
 
+        if backend:
+            # the named backend is authoritative: the descriptor stores only
+            # the NAME, so the upload must use exactly what a later reopen
+            # will resolve — caller-supplied endpoint/creds are ignored
+            from .backend_config import resolve_backend
+
+            bc = resolve_backend(backend)
+            endpoint = bc["endpoint"]
+            access_key = bc["access_key"]
+            secret_key = bc["secret_key"]
+        if not endpoint:
+            raise VolumeError("tier_upload needs -backend or an endpoint")
         with self._lock:
             was_read_only = self.read_only
             self.read_only = True
@@ -511,17 +541,23 @@ class Volume:
                 # the seal only sticks once the upload committed
                 self.read_only = was_read_only
                 raise
-            # creds ride in the descriptor (0600) so the volume still opens
-            # after a server restart; the reference keeps them in the named
-            # backend config the .vif points at (backend/s3_backend)
             info = {
-                "endpoint": endpoint,
                 "bucket": bucket,
                 "key": key,
                 "size": size,
-                "access_key": access_key,
-                "secret_key": secret_key,
             }
+            if backend:
+                # descriptor names the backend; secrets stay in backend.toml
+                info["backend"] = backend
+            else:
+                # legacy inline-creds flavor (0600): still supported so a
+                # cluster without backend.toml keeps working, but secrets
+                # land in every data dir — prefer -backend
+                info.update(
+                    endpoint=endpoint,
+                    access_key=access_key,
+                    secret_key=secret_key,
+                )
             tf = self.tier_file()
             fd = os.open(tf, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
             with os.fdopen(fd, "w") as f:
@@ -550,10 +586,9 @@ class Volume:
         with self._lock:
             with open(self.tier_file()) as f:
                 info = _json.load(f)
+            endpoint, ak, sk = self._tier_credentials(info)
             client = S3Client(
-                info["endpoint"],
-                access_key or info.get("access_key", ""),
-                secret_key or info.get("secret_key", ""),
+                endpoint, access_key or ak, secret_key or sk
             )
             local = self.file_name() + ".dat"
             try:

@@ -170,6 +170,16 @@ account_key = ""
 container = "mirror"
 directory = ""
 """,
+    "backend": """\
+# backend.toml — named remote storage backends for cloud tiering.
+# Volumes tiered with -backend=s3.default store only the backend NAME in
+# their .tier descriptor; the credentials live here, not in the data dirs.
+
+[s3.default]
+endpoint = "https://s3.us-east-1.amazonaws.com"
+access_key = ""
+secret_key = ""
+""",
     "notification": """\
 # notification.toml — filer event bus (first enabled queue wins)
 

@@ -152,6 +152,56 @@ def test_tier_upload_read_download(cluster, s3_tier, tmp_path):
     assert status == 200 and data == b"tiered 3" * 100
 
 
+def test_tier_named_backend_keeps_secrets_out(
+    cluster, s3_tier, tmp_path, monkeypatch
+):
+    """-backend=s3.xxx: the .tier descriptor carries only the backend name;
+    credentials resolve through backend.toml at open/download time."""
+    import json
+    import os
+
+    from seaweedfs_tpu.storage import backend_config
+    from seaweedfs_tpu.util.config import Configuration
+
+    master, volume = cluster
+    fid = operation.submit(master.url, b"named backend payload " * 200)
+    vid = int(fid.split(",")[0])
+    vol_url = f"{volume.host}:{volume.port}"
+    conf = Configuration(
+        {"s3": {"lab": {
+            "endpoint": f"http://{s3_tier.url}",
+            "access_key": "",
+            "secret_key": "",
+        }}},
+        "backend",
+    )
+    monkeypatch.setattr(
+        backend_config, "load_configuration", lambda name: conf
+    )
+    r = http_json(
+        "POST",
+        f"http://{vol_url}/admin/tier_upload?volume={vid}"
+        f"&bucket=tier2&backend=s3.lab",
+    )
+    assert r.get("key"), r
+    v = volume.store.find_volume(vid)
+    with open(v.tier_file()) as f:
+        info = json.load(f)
+    assert info["backend"] == "s3.lab"
+    for forbidden in ("access_key", "secret_key", "endpoint"):
+        assert forbidden not in info, info
+    # reads resolve the backend by name
+    status, data = http_bytes("GET", f"http://{vol_url}/{fid}")
+    assert status == 200 and data == b"named backend payload " * 200
+    # download back resolves creds the same way
+    r = http_json("POST", f"http://{vol_url}/admin/tier_download?volume={vid}")
+    assert r.get("ok"), r
+    assert os.path.exists(v.file_name() + ".dat")
+    # unknown backend name is a clear error
+    with pytest.raises(KeyError):
+        backend_config.resolve_backend("s3.nope", conf)
+
+
 def test_tiered_volume_survives_reload(cluster, s3_tier, tmp_path):
     """A restarted volume server reopens tiered volumes from .tier files."""
     master, volume = cluster
