@@ -279,20 +279,48 @@ def cmd_s3(args):
     if args.config:
         with open(args.config) as f:
             iam = IAM.from_config(_json.load(f))
+    cert, key, ca = _tls_triplet(args, "s3")
     api = S3ApiServer(
-        host=args.ip, port=args.port, filer_url=args.filer, iam=iam
+        host=args.ip, port=args.port, filer_url=args.filer, iam=iam,
+        tls_cert=cert, tls_key=key, tls_ca=ca,
     ).start()
-    print(f"s3 gateway on {api.url} → filer {args.filer}")
+    scheme = "https" if cert else "http"
+    print(f"s3 gateway on {scheme}://{api.url} → filer {args.filer}")
     _wait_forever()
+
+
+def _add_tls_flags(parser):
+    parser.add_argument("-cert.file", dest="cert", default="",
+                        help="TLS certificate (enables https)")
+    parser.add_argument("-key.file", dest="key", default="",
+                        help="private key; empty = combined PEM in cert.file")
+    parser.add_argument("-caCert.file", dest="ca_cert", default="",
+                        help="require CA-signed client certs (mTLS)")
+
+
+def _tls_triplet(args, component):
+    """-cert.file flags win; security.toml [tls.<component>] is the
+    fallback (security/tls.go loads per-component pairs the same way)."""
+    from .util.config import load_configuration
+
+    sec = load_configuration("security")
+    return (
+        args.cert or sec.get(f"tls.{component}.cert", "") or "",
+        args.key or sec.get(f"tls.{component}.key", "") or "",
+        args.ca_cert or sec.get("tls.ca", "") or "",
+    )
 
 
 def cmd_webdav(args):
     from .server.webdav_server import WebDavServer
 
+    cert, key, ca = _tls_triplet(args, "webdav")
     srv = WebDavServer(
-        host=args.ip, port=args.port, filer_url=args.filer, root=args.root
+        host=args.ip, port=args.port, filer_url=args.filer, root=args.root,
+        tls_cert=cert, tls_key=key, tls_ca=ca,
     ).start()
-    print(f"webdav on {srv.url} → filer {args.filer}")
+    scheme = "https" if cert else "http"
+    print(f"webdav on {scheme}://{srv.url} → filer {args.filer}")
     _wait_forever()
 
 
@@ -603,6 +631,7 @@ def main(argv=None):
     s3.add_argument("-port", type=int, default=8333)
     s3.add_argument("-filer", default="127.0.0.1:8888")
     s3.add_argument("-config", default="", help="identities json (s3.json)")
+    _add_tls_flags(s3)
     s3.set_defaults(fn=cmd_s3)
 
     wd = sub.add_parser("webdav", help="WebDAV gateway over a filer")
@@ -610,6 +639,7 @@ def main(argv=None):
     wd.add_argument("-port", type=int, default=7333)
     wd.add_argument("-filer", default="127.0.0.1:8888")
     wd.add_argument("-root", default="/")
+    _add_tls_flags(wd)
     wd.set_defaults(fn=cmd_webdav)
 
     ftp = sub.add_parser("ftp", help="FTP gateway over a filer")

@@ -23,11 +23,15 @@ class S3Client:
         access_key: str = "",
         secret_key: str = "",
         region: str = "us-east-1",
+        ssl_context=None,
     ):
         self.endpoint = endpoint.rstrip("/")
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
+        # https endpoints: pinned CA and/or client cert (security/tls.py
+        # client_context); None = system defaults for https, n/a for http
+        self.ssl_context = ssl_context
 
     # -- SigV4 ---------------------------------------------------------------
     def _sign(
@@ -171,7 +175,7 @@ class S3Client:
             url, data=body if body else None, method=method, headers=headers
         )
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(req, timeout=30, context=self.ssl_context) as resp:
                 return resp.status, resp.read(), dict(resp.headers)
         except urllib.error.HTTPError as e:
             return e.code, e.read(), dict(e.headers)
@@ -228,7 +232,7 @@ class S3Client:
             url, data=bytes(framed), method="PUT", headers=headers
         )
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(req, timeout=30, context=self.ssl_context) as resp:
                 return resp.status, resp.read(), dict(resp.headers)
         except urllib.error.HTTPError as e:
             return e.code, e.read(), dict(e.headers)

@@ -64,6 +64,9 @@ class S3ApiServer:
         port: int = 8333,
         filer_url: str = "127.0.0.1:8888",
         iam: IAM | None = None,
+        tls_cert: str = "",
+        tls_key: str = "",
+        tls_ca: str = "",
     ):
         self.host, self.port = host, port
         self.client = FilerClient(filer_url)
@@ -71,6 +74,7 @@ class S3ApiServer:
         self._policy_cache: dict = {}  # bucket → (BucketPolicy | None,)
         self._policy_lock = threading.Lock()  # handler threads race the cache
         self._policy_gen: dict = {}  # bucket → invalidation generation
+        self._tls = (tls_cert, tls_key, tls_ca)
         self._srv = None
 
     # ---------------------------------------------------------------- helpers
@@ -921,7 +925,10 @@ class S3ApiServer:
             def do_HEAD(self):
                 self._go("HEAD")
 
-        self._srv = start_server(Handler, self.host, self.port)
+        from ..security.tls import optional_server_context
+
+        ctx = optional_server_context(*self._tls)
+        self._srv = start_server(Handler, self.host, self.port, ssl_context=ctx)
         return self
 
     def stop(self):

@@ -77,8 +77,35 @@ class JsonHandler(BaseHTTPRequestHandler):
         self._dispatch("HEAD")
 
 
-def start_server(handler_cls, host: str, port: int) -> ThreadingHTTPServer:
-    srv = ThreadingHTTPServer((host, port), handler_cls)
+def start_server(
+    handler_cls, host: str, port: int, ssl_context=None
+) -> ThreadingHTTPServer:
+    if ssl_context is None:
+        srv = ThreadingHTTPServer((host, port), handler_cls)
+    else:
+        import ssl as _ssl
+
+        class _TlsServer(ThreadingHTTPServer):
+            """Handshake in the WORKER thread with a deadline — wrapping the
+            listening socket would run handshakes inside the single accept
+            loop, letting one stalled client freeze the whole server."""
+
+            def finish_request(self, request, client_address):
+                try:
+                    request.settimeout(10)
+                    tls_conn = ssl_context.wrap_socket(
+                        request, server_side=True
+                    )
+                    tls_conn.settimeout(None)
+                except (_ssl.SSLError, OSError):
+                    try:
+                        request.close()
+                    except OSError:
+                        pass
+                    return
+                self.RequestHandlerClass(tls_conn, client_address, self)
+
+        srv = _TlsServer((host, port), handler_cls)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv

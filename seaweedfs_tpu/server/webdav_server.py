@@ -67,10 +67,14 @@ class WebDavServer:
         port: int = 7333,
         filer_url: str = "127.0.0.1:8888",
         root: str = "/",
+        tls_cert: str = "",
+        tls_key: str = "",
+        tls_ca: str = "",
     ):
         self.host, self.port = host, port
         self.client = FilerClient(filer_url)
         self.root = root.rstrip("/")
+        self._tls = (tls_cert, tls_key, tls_ca)
         self._srv = None
 
     def _fp(self, dav_path: str) -> str:
@@ -279,7 +283,10 @@ class WebDavServer:
                 # accepted but ignored (live props are computed)
                 self._go("PROPFIND")
 
-        self._srv = start_server(Handler, self.host, self.port)
+        from ..security.tls import optional_server_context
+
+        ctx = optional_server_context(*self._tls)
+        self._srv = start_server(Handler, self.host, self.port, ssl_context=ctx)
         return self
 
     def stop(self):

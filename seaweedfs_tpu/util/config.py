@@ -95,6 +95,23 @@ key = ""
 [guard]
 # ip whitelist for admin/write surfaces; empty = allow all
 white_list = []
+
+[tls]
+# cluster CA; when set, TLS-enabled servers REQUIRE CA-signed client
+# certificates (mTLS, like the reference's [grpc] ca)
+ca = ""
+
+[tls.s3]
+cert = ""
+key = ""
+
+[tls.webdav]
+cert = ""
+key = ""
+
+[tls.client]
+cert = ""
+key = ""
 """,
     "master": """\
 # master.toml

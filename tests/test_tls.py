@@ -1,0 +1,223 @@
+"""TLS termination + mTLS on the gateway surfaces.
+
+Reference: `weed/security/tls.go` (RequireAndVerifyClientCert with a
+cluster CA) and `weed s3 -cert.file/-key.file` (`command/s3.go:42`).
+Certificates are minted per-run with the openssl CLI.
+"""
+
+import socket
+import ssl
+import subprocess
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.s3api import IAM, Identity, S3ApiServer
+from seaweedfs_tpu.s3api.s3_client import S3Client
+from seaweedfs_tpu.security import tls as wtls
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _openssl(*args):
+    subprocess.run(
+        ["openssl", *args], check=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """CA + server pair (SAN 127.0.0.1) + client pair + a rogue self-signed
+    client cert NOT issued by the CA."""
+    d = tmp_path_factory.mktemp("certs")
+    ca_key, ca = str(d / "ca.key"), str(d / "ca.crt")
+    _openssl("req", "-x509", "-newkey", "rsa:2048", "-nodes", "-keyout",
+             ca_key, "-out", ca, "-days", "2", "-subj", "/CN=weed-test-ca")
+    out = {"ca": ca, "dir": d}
+    for name, cn, ext in (
+        ("server", "127.0.0.1", "subjectAltName=IP:127.0.0.1"),
+        ("client", "ops-client", None),
+    ):
+        key, csr, crt = (str(d / f"{name}.{e}") for e in ("key", "csr", "crt"))
+        _openssl("req", "-newkey", "rsa:2048", "-nodes", "-keyout", key,
+                 "-out", csr, "-subj", f"/CN={cn}")
+        sign = ["x509", "-req", "-in", csr, "-CA", ca, "-CAkey", ca_key,
+                "-CAcreateserial", "-out", crt, "-days", "2"]
+        if ext:
+            ext_file = str(d / f"{name}.ext")
+            with open(ext_file, "w") as f:
+                f.write(ext + "\n")
+            sign += ["-extfile", ext_file]
+        _openssl(*sign)
+        out[f"{name}_key"], out[f"{name}_crt"] = key, crt
+    rogue_key, rogue = str(d / "rogue.key"), str(d / "rogue.crt")
+    _openssl("req", "-x509", "-newkey", "rsa:2048", "-nodes", "-keyout",
+             rogue_key, "-out", rogue, "-days", "2", "-subj", "/CN=rogue")
+    out["rogue_key"], out["rogue_crt"] = rogue_key, rogue
+    return out
+
+
+@pytest.fixture(scope="module")
+def tls_stack(tmp_path_factory, certs):
+    tmp = tmp_path_factory.mktemp("tls")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp / "v")], port=free_port(), master_url=master.url,
+        max_volume_count=10, pulse_seconds=0.5,
+    ).start()
+    filer = FilerServer(port=free_port(), master_url=master.url).start()
+    iam = IAM([Identity("u", "AK", "SK", ["Admin", "Read", "Write", "List"])])
+    api = S3ApiServer(
+        port=free_port(), filer_url=filer.url, iam=iam,
+        tls_cert=certs["server_crt"], tls_key=certs["server_key"],
+        tls_ca=certs["ca"],
+    ).start()
+    time.sleep(0.5)
+    yield api
+    api.stop()
+    filer.stop()
+    volume.stop()
+    master.stop()
+
+
+def test_mtls_client_cert_accepted(tls_stack, certs):
+    ctx = wtls.client_context(
+        certs["ca"], certs["client_crt"], certs["client_key"]
+    )
+    c = S3Client(
+        f"https://127.0.0.1:{tls_stack.port}", "AK", "SK", ssl_context=ctx
+    )
+    status, body, _ = c.create_bucket("secure")
+    assert status in (200, 201), body
+    status, _, _ = c.put_object("secure", "x.bin", b"over mtls")
+    assert status == 200
+    status, data, _ = c.get_object("secure", "x.bin")
+    assert status == 200 and data == b"over mtls"
+
+
+def test_mtls_rejects_missing_or_rogue_client_cert(tls_stack, certs):
+    # no client cert: handshake refused
+    ctx = wtls.client_context(certs["ca"])
+    with pytest.raises((ssl.SSLError, urllib.error.URLError, OSError)):
+        urllib.request.urlopen(
+            f"https://127.0.0.1:{tls_stack.port}/", context=ctx, timeout=5
+        )
+    # cert from outside the CA: also refused
+    ctx = wtls.client_context(
+        certs["ca"], certs["rogue_crt"], certs["rogue_key"]
+    )
+    with pytest.raises((ssl.SSLError, urllib.error.URLError, OSError)):
+        urllib.request.urlopen(
+            f"https://127.0.0.1:{tls_stack.port}/", context=ctx, timeout=5
+        )
+
+
+def test_client_verifies_server_against_ca(tls_stack, certs):
+    # a client pinning the CA rejects a server whose cert the CA didn't sign
+    rogue_srv_ctx = wtls.server_context(
+        certs["rogue_crt"], certs["rogue_key"]
+    )
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class _H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    srv = HTTPServer(("127.0.0.1", 0), _H)
+    srv.socket = rogue_srv_ctx.wrap_socket(srv.socket, server_side=True)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        ctx = wtls.client_context(
+            certs["ca"], certs["client_crt"], certs["client_key"]
+        )
+        ctx.check_hostname = False  # isolate the chain check
+        with pytest.raises((ssl.SSLError, urllib.error.URLError)):
+            urllib.request.urlopen(
+                f"https://127.0.0.1:{srv.server_address[1]}/",
+                context=ctx, timeout=5,
+            )
+    finally:
+        srv.shutdown()
+
+
+def test_stalled_client_does_not_block_server(tls_stack, certs):
+    """A TCP client that never speaks TLS must not freeze the accept loop
+    (handshakes run per-connection in worker threads with a deadline)."""
+    stall = socket.create_connection(("127.0.0.1", tls_stack.port))
+    try:
+        ctx = wtls.client_context(
+            certs["ca"], certs["client_crt"], certs["client_key"]
+        )
+        c = S3Client(
+            f"https://127.0.0.1:{tls_stack.port}", "AK", "SK",
+            ssl_context=ctx,
+        )
+        t0 = time.monotonic()
+        status, _, _ = c.request("GET", "/")
+        assert status == 200 and time.monotonic() - t0 < 5
+    finally:
+        stall.close()
+
+
+def test_tls_misconfig_and_combined_pem(certs, tmp_path):
+    # ca/key without cert refuses to start rather than serving plaintext
+    with pytest.raises(ValueError, match="cert.file"):
+        wtls.optional_server_context("", "", certs["ca"])
+    with pytest.raises(ValueError, match="cert.file"):
+        wtls.optional_server_context("", certs["server_key"], "")
+    assert wtls.optional_server_context("", "", "") is None
+    # combined cert+key PEM with no key file works on both sides
+    combined = tmp_path / "combined.pem"
+    combined.write_bytes(
+        open(certs["server_crt"], "rb").read()
+        + open(certs["server_key"], "rb").read()
+    )
+    assert wtls.optional_server_context(str(combined)) is not None
+    # client without CA keeps system verification unless insecure=True
+    ctx = wtls.client_context()
+    assert ctx.verify_mode == ssl.CERT_REQUIRED
+    ctx = wtls.client_context(insecure=True)
+    assert ctx.verify_mode == ssl.CERT_NONE
+
+
+def test_plain_tls_without_ca_allows_any_client(tmp_path, certs):
+    """cert/key without -caCert = ordinary https (no client certs)."""
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp_path / "v")], port=free_port(), master_url=master.url,
+        max_volume_count=10, pulse_seconds=0.5,
+    ).start()
+    filer = FilerServer(port=free_port(), master_url=master.url).start()
+    api = S3ApiServer(
+        port=free_port(), filer_url=filer.url,
+        tls_cert=certs["server_crt"], tls_key=certs["server_key"],
+    ).start()
+    try:
+        time.sleep(0.4)
+        ctx = wtls.client_context(certs["ca"])  # CA pin, no client cert
+        c = S3Client(f"https://127.0.0.1:{api.port}", ssl_context=ctx)
+        status, _, _ = c.create_bucket("plain-tls")
+        assert status in (200, 201)
+    finally:
+        api.stop()
+        filer.stop()
+        volume.stop()
+        master.stop()
