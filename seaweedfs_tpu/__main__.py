@@ -488,6 +488,99 @@ def cmd_shell(args):
     run_shell(args.master, args.filer, command=args.command)
 
 
+def cmd_fix(args):
+    """Re-create a volume's .idx from its .dat (`weed fix`, command/fix.go)."""
+    from .storage.volume import Volume, volume_file_name
+
+    base = volume_file_name(args.dir, args.collection, args.volume_id)
+    idx = base + ".idx"
+    if not (os.path.exists(base + ".dat") or os.path.exists(base + ".tier")):
+        # validate BEFORE touching the index — a typo'd -dir must not
+        # destroy a stray .idx it can't rebuild
+        raise SystemExit(f"no volume data at {base}.dat")
+    if os.path.exists(idx):
+        os.unlink(idx)  # fix.go requires the index gone; we just redo it
+    v = Volume(
+        args.dir, collection=args.collection, vid=args.volume_id,
+        create_if_missing=False,
+    )
+    print(
+        f"fixed {idx}: {v.file_count()} entries "
+        f"({v.deleted_count()} tombstones)"
+    )
+    v.close()
+
+
+def cmd_compact(args):
+    """Offline-compact a volume (`weed compact`, command/compact.go)."""
+    from .storage.volume import Volume
+
+    v = Volume(
+        args.dir, collection=args.collection, vid=args.volume_id,
+        create_if_missing=False,
+    )
+    before = v.size()
+    v.compact()
+    after = v.size()
+    print(
+        f"volume {args.volume_id}: {before} → {after} bytes "
+        f"({before - after} reclaimed)"
+    )
+    v.close()
+
+
+def cmd_export(args):
+    """Export live needles to a tar archive (`weed export`, command/export.go)."""
+    import tarfile
+    from datetime import datetime
+    from io import BytesIO
+
+    from .storage.volume import Volume
+
+    newer_than = 0.0
+    if args.newer:
+        newer_than = datetime.fromisoformat(args.newer).timestamp()
+    v = Volume(
+        args.dir, collection=args.collection, vid=args.volume_id,
+        create_if_missing=False,
+    )
+    from .storage.types import size_is_valid
+
+    count = skipped = 0
+    with tarfile.open(args.output, "w") as tf:
+        for n, offset, _ in v.scan_needles():
+            nv = v.nm.get(n.id)
+            if (
+                nv is None
+                or not size_is_valid(nv.size)  # tombstoned
+                or nv.offset != offset  # superseded by an overwrite
+                or not n.data
+            ):
+                continue
+            # timestamp-less needles (last_modified 0) fail the cutoff too,
+            # matching export.go's unconditional compare
+            if newer_than and n.last_modified < newer_than:
+                skipped += 1
+                continue
+            name = (
+                n.name.decode("utf-8", "replace")
+                if n.name
+                else f"{v.id:d}_{n.id:x}"
+            )
+            data = bytes(n.data)
+            if n.is_compressed:
+                from .util.compression import ungzip_data
+
+                data = ungzip_data(data)
+            info = tarfile.TarInfo(name=name)
+            info.size = len(data)
+            info.mtime = n.last_modified or int(time.time())
+            tf.addfile(info, BytesIO(data))
+            count += 1
+    print(f"exported {count} files to {args.output} ({skipped} skipped)")
+    v.close()
+
+
 def cmd_version(args):
     from . import __version__
 
@@ -708,6 +801,27 @@ def main(argv=None):
     sh.add_argument("-c", dest="command", default="",
                     help="run ;-separated commands and exit (non-interactive)")
     sh.set_defaults(fn=cmd_shell)
+
+    fx = sub.add_parser("fix", help="rebuild a volume's .idx from its .dat")
+    fx.add_argument("-dir", default=".")
+    fx.add_argument("-collection", default="")
+    fx.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    fx.set_defaults(fn=cmd_fix)
+
+    cp2 = sub.add_parser("compact", help="offline-compact a volume")
+    cp2.add_argument("-dir", default=".")
+    cp2.add_argument("-collection", default="")
+    cp2.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    cp2.set_defaults(fn=cmd_compact)
+
+    ex = sub.add_parser("export", help="export volume contents to a tar")
+    ex.add_argument("-dir", default=".")
+    ex.add_argument("-collection", default="")
+    ex.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    ex.add_argument("-o", dest="output", required=True, help="output .tar")
+    ex.add_argument("-newer", default="",
+                    help="only files newer than ISO timestamp")
+    ex.set_defaults(fn=cmd_export)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=cmd_version)

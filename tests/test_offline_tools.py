@@ -1,0 +1,107 @@
+"""Offline volume tools: weed fix / compact / export as CLI subprocesses.
+
+Reference: `weed/command/fix.go` (rebuild .idx from .dat),
+`weed/command/compact.go`, `weed/command/export.go` (tar of live needles,
+-newer filter, ${name} fallback naming).
+"""
+
+import os
+import subprocess
+import sys
+import tarfile
+
+from seaweedfs_tpu.storage.needle import FLAG_HAS_NAME, Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(*args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        env=dict(os.environ, PYTHONPATH=REPO), cwd=str(cwd),
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def _make_volume(tmp_path, vid=9):
+    v = Volume(str(tmp_path), collection="", vid=vid)
+    for i in range(1, 21):
+        n = Needle(cookie=5, id=i, data=f"needle-{i}".encode() * 20)
+        n.name = f"file{i}.txt".encode()
+        n.set_flag(FLAG_HAS_NAME)
+        v.write_needle(n)
+    for i in range(1, 8):
+        v.delete_needle(Needle(cookie=5, id=i))
+    v.close()
+    return vid
+
+
+def test_fix_rebuilds_index(tmp_path):
+    vid = _make_volume(tmp_path)
+    idx = tmp_path / f"{vid}.idx"
+    os.unlink(idx)
+    out = _run("fix", "-dir", ".", "-volumeId", str(vid), cwd=tmp_path)
+    assert out.returncode == 0, out.stderr
+    assert idx.exists()
+    # the rebuilt index serves reads and honors tombstones
+    v = Volume(str(tmp_path), collection="", vid=vid)
+    n = Needle(id=15)
+    v.read_needle(n)
+    assert bytes(n.data) == b"needle-15" * 20
+    try:
+        v.read_needle(Needle(id=3))
+        raise AssertionError("deleted needle must stay deleted after fix")
+    except Exception:
+        pass
+    v.close()
+
+
+def test_fix_refuses_without_dat(tmp_path):
+    """A typo'd invocation must not destroy a stray index file."""
+    stray = tmp_path / "42.idx"
+    stray.write_bytes(b"\x00" * 16)
+    out = _run("fix", "-dir", ".", "-volumeId", "42", cwd=tmp_path)
+    assert out.returncode != 0
+    assert stray.exists(), "stray .idx must survive a failed fix"
+
+
+def test_export_newer_excludes_timestampless(tmp_path):
+    vid = _make_volume(tmp_path)
+    out = _run(
+        "export", "-dir", ".", "-volumeId", str(vid), "-o", "none.tar",
+        "-newer", "2100-01-01T00:00:00", cwd=tmp_path,
+    )
+    assert out.returncode == 0, out.stderr
+    with tarfile.open(tmp_path / "none.tar") as tf:
+        assert tf.getnames() == []  # everything is older than year 2100
+
+
+def test_compact_reclaims_space(tmp_path):
+    vid = _make_volume(tmp_path)
+    before = (tmp_path / f"{vid}.dat").stat().st_size
+    out = _run("compact", "-dir", ".", "-volumeId", str(vid), cwd=tmp_path)
+    assert out.returncode == 0, out.stderr
+    assert "reclaimed" in out.stdout
+    after = (tmp_path / f"{vid}.dat").stat().st_size
+    assert after < before
+    v = Volume(str(tmp_path), collection="", vid=vid)
+    n = Needle(id=20)
+    v.read_needle(n)
+    assert bytes(n.data) == b"needle-20" * 20
+    v.close()
+
+
+def test_export_tar_of_live_needles(tmp_path):
+    vid = _make_volume(tmp_path)
+    out = _run(
+        "export", "-dir", ".", "-volumeId", str(vid), "-o", "dump.tar",
+        cwd=tmp_path,
+    )
+    assert out.returncode == 0, out.stderr
+    with tarfile.open(tmp_path / "dump.tar") as tf:
+        names = tf.getnames()
+        assert "file15.txt" in names and "file3.txt" not in names
+        assert len(names) == 13  # 20 written − 7 deleted
+        data = tf.extractfile("file15.txt").read()
+        assert data == b"needle-15" * 20
