@@ -169,6 +169,7 @@ def cmd_upload(args):
             replication=args.replication,
             collection=args.collection,
             ttl=args.ttl,
+            max_mb=args.max_mb,
         )
         print(f"{path}\t{fid}")
 
@@ -685,6 +686,8 @@ def main(argv=None):
     u.add_argument("-replication", default="")
     u.add_argument("-collection", default="")
     u.add_argument("-ttl", default="")
+    u.add_argument("-maxMB", dest="max_mb", type=int, default=32,
+                   help="split larger files into chunks + manifest needle")
     u.add_argument("files", nargs="+")
     u.set_defaults(fn=cmd_upload)
 

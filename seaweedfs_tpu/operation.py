@@ -56,6 +56,7 @@ def upload_data(
     ttl: str = "",
     jwt: str = "",
     compress: bool = True,
+    is_chunk_manifest: bool = False,
 ) -> dict:
     import urllib.request
 
@@ -76,6 +77,8 @@ def upload_data(
     )
     if gzipped:
         req.add_header("Content-Encoding", "gzip")
+    if is_chunk_manifest:
+        req.add_header("X-Sweed-Chunk-Manifest", "true")
     if name:
         req.add_header("X-Sweed-Name", name)
     if mime:
@@ -163,10 +166,70 @@ def submit(
     replication: str = "",
     collection: str = "",
     ttl: str = "",
+    max_mb: int = 0,
 ) -> str:
-    """Assign + upload in one call (submit.go:41). Returns the fid."""
+    """Assign + upload in one call (submit.go:41). Returns the fid.
+
+    With max_mb > 0, files past the limit are split into chunk needles
+    plus a manifest needle the volume server resolves on read
+    (submit.go:115 upload_chunked_file + operation/chunked_file.go) —
+    large objects without a filer in the path."""
+    if max_mb > 0 and len(data) > max_mb * 1024 * 1024:
+        return _submit_chunked(
+            master, data, name, mime, replication, collection, ttl,
+            max_mb * 1024 * 1024,
+        )
     a = assign(
         master, replication=replication, collection=collection, ttl=ttl
     )
     upload_data(a.url, a.fid, data, name=name, mime=mime, ttl=ttl, jwt=a.auth)
     return a.fid
+
+
+def _submit_chunked(
+    master: str,
+    data: bytes,
+    name: str,
+    mime: str,
+    replication: str,
+    collection: str,
+    ttl: str,
+    chunk_size: int,
+) -> str:
+    import json
+
+    chunks = []
+    try:
+        for off in range(0, len(data), chunk_size):
+            piece = data[off : off + chunk_size]
+            a = assign(
+                master, replication=replication, collection=collection,
+                ttl=ttl,
+            )
+            # chunk bytes go up verbatim: the manifest read path
+            # concatenates stored bytes, so per-chunk compression would
+            # corrupt the stream
+            upload_data(
+                a.url, a.fid, piece, ttl=ttl, jwt=a.auth, compress=False
+            )
+            chunks.append({"fid": a.fid, "offset": off, "size": len(piece)})
+        manifest = json.dumps(
+            {"name": name, "mime": mime, "size": len(data), "chunks": chunks}
+        ).encode()
+        a = assign(
+            master, replication=replication, collection=collection, ttl=ttl
+        )
+        upload_data(
+            a.url, a.fid, manifest, name=name, mime=mime, ttl=ttl,
+            jwt=a.auth, compress=False, is_chunk_manifest=True,
+        )
+        return a.fid
+    except Exception:
+        # no fid reaches the caller, so already-uploaded chunks would be
+        # unreferenced garbage forever — sweep them (submit.go cleanup)
+        if chunks:
+            try:
+                delete_files(master, [c["fid"] for c in chunks])
+            except Exception:
+                pass  # best effort; the original error matters more
+        raise

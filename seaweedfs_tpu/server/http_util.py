@@ -51,9 +51,14 @@ class JsonHandler(BaseHTTPRequestHandler):
             ctype = "application/json"
         if self.extra_headers and "Content-Type" in self.extra_headers:
             ctype = self.extra_headers.pop("Content-Type")
+        clen = str(len(data))
+        if self.extra_headers and "Content-Length" in self.extra_headers:
+            # HEAD answers for chunked manifests advertise the full size
+            # without materializing the body
+            clen = self.extra_headers.pop("Content-Length")
         self.send_response(status)
         self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
+        self.send_header("Content-Length", clen)
         for k, v in (self.extra_headers or {}).items():
             self.send_header(k, v)
         self.extra_headers = None

@@ -75,6 +75,7 @@ class VolumeServer:
         )
         self.jwt_signing_key = jwt_signing_key
         self.jwt_read_key = jwt_read_key
+        self._chunk_lookup = None  # LookupCache, built on first chunked read
         self.guard = Guard(whitelist)
         self.host, self.port = host, port
         # comma-separated seed list (weed volume -mserver=a,b,c); the live
@@ -168,6 +169,10 @@ class VolumeServer:
             if n.cookie != cookie:
                 return 404, {"error": "cookie mismatch"}
             data = bytes(n.data)
+            if n.is_chunk_manifest and q.get("cm") != "false":
+                # server-side chunked-file resolution
+                # (volume_server_handlers_read.go:181)
+                return self._serve_chunked_manifest(h, n, data)
             if n.is_compressed:
                 # serve gzip verbatim only to clients that asked for it;
                 # everyone else gets the original bytes
@@ -193,6 +198,77 @@ class VolumeServer:
                 )
             return 200, data
 
+    def _serve_chunked_manifest(self, h, n, manifest_bytes: bytes):
+        """Concatenate a chunked file from its manifest
+        (operation/chunked_file.go; served like
+        volume_server_handlers_read.go:181-200)."""
+        import json as _json
+
+        from ..util.compression import maybe_decompress
+
+        mf = _json.loads(maybe_decompress(manifest_bytes))
+        headers = {}
+        if mf.get("mime"):
+            headers["Content-Type"] = mf["mime"]
+        if h.command == "HEAD":
+            # answer from manifest metadata; don't materialize gigabytes
+            headers["Content-Length"] = str(mf.get("size", 0))
+            h.extra_headers = headers
+            return 200, b""
+        out = bytearray(mf.get("size", 0))
+        for c in sorted(mf.get("chunks", []), key=lambda c: c["offset"]):
+            status, piece = self._fetch_fid(c["fid"])
+            if status != 200:
+                return 500, {"error": f"chunk {c['fid']}: HTTP {status}"}
+            out[c["offset"] : c["offset"] + len(piece)] = piece
+        if headers:
+            h.extra_headers = headers
+        return 200, bytes(out)
+
+    def _fetch_fid(self, fid: str) -> tuple[int, bytes]:
+        """Read a fid wherever it lives: local store first, then via the
+        cached master lookup (chunks may land on other volume servers)."""
+        try:
+            vid = int(fid.split(",")[0])
+        except ValueError:
+            return 400, b""
+        v = self.store.find_volume(vid)
+        if v is not None:
+            from ..storage.file_id import FileId
+
+            f = FileId.parse(fid)
+            n = Needle(id=f.key)
+            try:
+                self.store.read_volume_needle(vid, n)
+            except Exception:
+                return 404, b""
+            if n.cookie != f.cookie:
+                return 404, b""
+            data = bytes(n.data)
+            if n.is_compressed:
+                from ..util.compression import ungzip_data
+
+                data = ungzip_data(data)
+            return 200, data
+        from .. import operation
+
+        if self._chunk_lookup is None:
+            self._chunk_lookup = operation.LookupCache(self.master_url)
+        auth = ""
+        if self.jwt_read_key:
+            from ..security import gen_jwt
+
+            auth = "?auth=" + gen_jwt(self.jwt_read_key, fid)
+        try:
+            locs = self._chunk_lookup.lookup(vid)
+        except Exception:
+            locs = []
+        for loc in locs:
+            status, data = http_bytes("GET", f"http://{loc['url']}/{fid}{auth}")
+            if status == 200:
+                return status, data
+        return 404, b""
+
     def _h_post(self, h, path, q, body):
         if not self.guard.allowed(h.client_address[0]):
             return 403, {"error": "ip not allowed"}
@@ -208,6 +284,10 @@ class VolumeServer:
             from ..storage.needle import FLAG_IS_COMPRESSED
 
             n.set_flag(FLAG_IS_COMPRESSED)
+        if h.headers.get("X-Sweed-Chunk-Manifest") == "true":
+            from ..storage.needle import FLAG_IS_CHUNK_MANIFEST
+
+            n.set_flag(FLAG_IS_CHUNK_MANIFEST)
         if name:
             n.name = name.encode()[:255]
             n.set_flag(FLAG_HAS_NAME)
@@ -242,12 +322,50 @@ class VolumeServer:
         if not self._auth_ok(h, path, q, self.jwt_signing_key):
             return 401, {"error": "unauthorized delete"}
         vid, nid, cookie = self._parse_fid_path(path)
+        # snapshot a manifest's chunk list BEFORE deleting it — but only
+        # cascade AFTER the manifest delete (incl. replication) succeeds,
+        # and only on the primary: a failed replicated delete must leave a
+        # readable file, and replicas must not re-issue the cascade
+        # (volume_server_handlers_write.go DeleteHandler)
+        chunk_fids: list = []
+        if q.get("type") != "replicate":
+            probe = Needle(id=nid)
+            try:
+                self.store.read_volume_needle(vid, probe)
+            except Exception:
+                probe = None
+            if (
+                probe is not None
+                and probe.cookie == cookie
+                and probe.is_chunk_manifest
+            ):
+                import json as _json
+
+                from ..util.compression import maybe_decompress
+
+                try:
+                    mf = _json.loads(maybe_decompress(bytes(probe.data)))
+                    chunk_fids = [
+                        c["fid"] for c in mf.get("chunks", [])
+                    ]
+                except Exception as e:  # noqa: BLE001
+                    glog.warning("manifest parse vid %d: %s", vid, e)
         n = Needle(cookie=cookie, id=nid)
         size = self.store.delete_volume_needle(vid, n)
         if q.get("type") != "replicate":
             err = self._replicate(path, q, b"", h, "DELETE")
             if err:
                 return 500, {"error": f"replicated delete failed: {err}"}
+            if chunk_fids:
+                from .. import operation
+
+                try:
+                    operation.delete_files(
+                        self.master_url, chunk_fids,
+                        jwt_key=self.jwt_signing_key,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    glog.warning("chunk cascade vid %d: %s", vid, e)
         return 202, {"size": size}
 
     def _replicate(self, path, q, body, h, method) -> Optional[str]:
@@ -263,7 +381,13 @@ class VolumeServer:
         fwd = {
             k: v
             for k, v in h.headers.items()
-            if k.title() in ("X-Sweed-Name", "X-Sweed-Mime", "Content-Encoding")
+            if k.title()
+            in (
+                "X-Sweed-Name",
+                "X-Sweed-Mime",
+                "Content-Encoding",
+                "X-Sweed-Chunk-Manifest",
+            )
         }
         for loc in r.get("locations", []):
             url = loc["url"]

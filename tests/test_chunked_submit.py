@@ -1,0 +1,137 @@
+"""Volume-level chunked files: submit -maxMB splits into chunk needles plus
+a manifest needle the volume server resolves on read and cascades on delete.
+
+Reference: `weed/operation/submit.go:115` (upload_chunked_file),
+`weed/operation/chunked_file.go` (ChunkManifest),
+`weed/server/volume_server_handlers_read.go:181` (server-side resolution),
+and the DeleteHandler chunk cascade.
+"""
+
+import json
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu import operation
+from seaweedfs_tpu.server.http_util import http_bytes
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("chunked")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    v1 = VolumeServer(
+        [str(tmp / "v1")], port=free_port(), master_url=master.url,
+        max_volume_count=10, pulse_seconds=0.5,
+    ).start()
+    v2 = VolumeServer(
+        [str(tmp / "v2")], port=free_port(), master_url=master.url,
+        max_volume_count=10, pulse_seconds=0.5,
+    ).start()
+    time.sleep(0.8)
+    yield master
+    v2.stop()
+    v1.stop()
+    master.stop()
+
+
+def _payload(mb: float) -> bytes:
+    unit = b"0123456789abcdef" * 64  # 1 KiB
+    return (unit * int(mb * 1024))[: int(mb * 1024 * 1024)]
+
+
+def test_chunked_submit_roundtrip(cluster):
+    data = _payload(2.5)
+    fid = operation.submit(cluster.url, data, name="big.bin", max_mb=1)
+    got = operation.download(cluster.url, fid)
+    assert got == data
+    # the stored needle really is a manifest (cm=false shows the raw JSON)
+    locs = operation.lookup(cluster.url, int(fid.split(",")[0]))
+    status, raw = http_bytes("GET", f"http://{locs[0]['url']}/{fid}?cm=false")
+    assert status == 200
+    mf = json.loads(raw)
+    assert mf["size"] == len(data) and len(mf["chunks"]) == 3
+    # each chunk is independently fetchable
+    for c in mf["chunks"]:
+        piece = operation.download(cluster.url, c["fid"])
+        assert piece == data[c["offset"] : c["offset"] + c["size"]]
+
+
+def test_small_files_not_chunked(cluster):
+    data = b"small payload"
+    fid = operation.submit(cluster.url, data, name="s.bin", max_mb=1)
+    locs = operation.lookup(cluster.url, int(fid.split(",")[0]))
+    status, raw = http_bytes("GET", f"http://{locs[0]['url']}/{fid}?cm=false")
+    assert status == 200 and raw == data  # no manifest indirection
+
+
+def test_manifest_mime_and_head(cluster):
+    import urllib.request
+
+    data = _payload(1.5)
+    fid = operation.submit(
+        cluster.url, data, name="v.mp4", mime="video/mp4", max_mb=1
+    )
+    locs = operation.lookup(cluster.url, int(fid.split(",")[0]))
+    url = f"http://{locs[0]['url']}/{fid}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.headers.get("Content-Type") == "video/mp4"
+        assert r.read() == data
+    # HEAD advertises the full size without materializing the body
+    req = urllib.request.Request(url, method="HEAD")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert int(r.headers["Content-Length"]) == len(data)
+        assert r.read() == b""
+
+
+def test_failed_chunk_upload_sweeps_orphans(cluster, monkeypatch):
+    deleted: list = []
+    real_upload = operation.upload_data
+    calls = {"n": 0}
+
+    def flaky_upload(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 3:  # fail on the third chunk
+            raise RuntimeError("injected upload failure")
+        return real_upload(*a, **k)
+
+    real_delete = operation.delete_files
+
+    def spy_delete(master, fids, jwt_key=""):
+        deleted.extend(fids)
+        return real_delete(master, fids, jwt_key=jwt_key)
+
+    monkeypatch.setattr(operation, "upload_data", flaky_upload)
+    monkeypatch.setattr(operation, "delete_files", spy_delete)
+    with pytest.raises(RuntimeError, match="injected"):
+        operation.submit(cluster.url, _payload(3.5), max_mb=1)
+    assert len(deleted) == 2  # the two chunks that made it up were swept
+    for fid in deleted:
+        with pytest.raises(RuntimeError):
+            operation.download(cluster.url, fid)
+
+
+def test_manifest_delete_cascades_to_chunks(cluster):
+    data = _payload(2.2)
+    fid = operation.submit(cluster.url, data, max_mb=1)
+    locs = operation.lookup(cluster.url, int(fid.split(",")[0]))
+    _, raw = http_bytes("GET", f"http://{locs[0]['url']}/{fid}?cm=false")
+    chunk_fids = [c["fid"] for c in json.loads(raw)["chunks"]]
+    assert operation.delete_file(cluster.url, fid)
+    time.sleep(0.2)
+    for cf in chunk_fids:
+        with pytest.raises(RuntimeError):
+            operation.download(cluster.url, cf)
+    with pytest.raises(RuntimeError):
+        operation.download(cluster.url, fid)
