@@ -659,6 +659,47 @@ class VolumeServer:
         self.store.queue_new_volume(v)
         return 200, {}
 
+    def _h_ec_to_volume(self, h, path, q, body):
+        """VolumeEcShardsToVolume (volume_grpc_erasure_coding.go): decode
+        the local shards back into a normal .dat/.idx volume and serve it."""
+        from ..ec import decoder as ec_decoder
+
+        vid = int(q["volume"])
+        base = self._find_base(vid)
+        if base is None or not os.path.exists(base + ".ecx"):
+            return 404, {"error": f"no local ec volume {vid}"}
+        dat_size = ec_decoder.decode_to_volume(
+            base, codec=self.store.ec_codec
+        )
+        # swap runtimes: EC registration AND its files go before the
+        # rescan — shard files still on disk would make
+        # load_existing_volumes re-create the EcVolume and the next full
+        # heartbeat re-announce shards the master was just told are gone
+        ev = self.store.find_ec_volume(vid)
+        bits = sum(1 << s for s in ev.shard_ids()) if ev else 0
+        collection = ev.collection if ev else q.get("collection", "")
+        for loc in self.store.locations:
+            loc.unload_ec_volume(vid)
+        for s in range(TOTAL_SHARDS):
+            try:
+                os.remove(base + shard_ext(s))
+            except FileNotFoundError:
+                pass
+        for ext in (".ecx", ".ecj"):
+            try:
+                os.remove(base + ext)
+            except FileNotFoundError:
+                pass
+        if bits:
+            self.store.queue_deleted_ec_shards(vid, collection, bits)
+        for loc in self.store.locations:
+            loc.load_existing_volumes()
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 500, {"error": "decoded volume failed to load"}
+        self.store.queue_new_volume(v)
+        return 200, {"dat_size": dat_size, "file_count": v.file_count()}
+
     def _h_ec_mount(self, h, path, q, body):
         vid = int(q["volume"])
         for loc in self.store.locations:
@@ -710,6 +751,16 @@ class VolumeServer:
             self.store.queue_deleted_ec_shards(
                 vid, collection, sum(1 << s for s in removed)
             )
+        if base and not any(
+            os.path.exists(base + shard_ext(s)) for s in range(TOTAL_SHARDS)
+        ):
+            # last shard gone: the index + deletion journal go with it
+            # (VolumeEcShardsDelete removes .ecx/.ecj when none remain)
+            for ext in (".ecx", ".ecj"):
+                try:
+                    os.remove(base + ext)
+                except FileNotFoundError:
+                    pass
         return 200, {"removed": removed}
 
     def _h_ec_shard_read(self, h, path, q, body):
@@ -907,6 +958,7 @@ class VolumeServer:
                 ("POST", "/admin/ec/rebuild", vs._h_ec_rebuild),
                 ("POST", "/admin/ec/copy", vs._h_ec_copy),
                 ("GET", "/admin/ec/shard_read", vs._h_ec_shard_read),
+                ("POST", "/admin/ec/to_volume", vs._h_ec_to_volume),
                 ("POST", "/admin/ec/mount", vs._h_ec_mount),
                 ("POST", "/admin/ec/unmount", vs._h_ec_unmount),
                 ("POST", "/admin/ec/delete_shards", vs._h_ec_delete_shards),

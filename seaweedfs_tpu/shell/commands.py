@@ -237,6 +237,72 @@ def ec_rebuild(env: CommandEnv, vid: int, collection: str = "") -> dict:
     }
 
 
+def ec_decode(env: CommandEnv, vid: int, collection: str = "") -> dict:
+    """Decode an erasure-coded volume back into a normal volume
+    (shell/command_ec_decode.go): collect the shards onto the node that
+    already holds the most, reconstruct .dat/.idx there, then unmount and
+    delete every shard cluster-wide."""
+    locs = env.ec_shard_locations(vid)
+    if not locs:
+        raise RuntimeError(f"no ec shards registered for volume {vid}")
+    counts: dict[str, int] = {}
+    for urls in locs.values():
+        for u in urls:
+            counts[u] = counts.get(u, 0) + 1
+    target = max(counts, key=lambda u: counts[u])
+    copied = []
+    for sid, urls in sorted(locs.items()):
+        if target in urls or not urls:
+            continue
+        # the target already holds .ecx/.vif (it has shards) — don't
+        # re-fetch the index with every shard
+        r = http_json(
+            "POST",
+            f"http://{target}/admin/ec/copy?volume={vid}"
+            f"&collection={collection}&shards={sid}&source={urls[0]}"
+            f"&copy_ecx=false&copy_vif=false",
+        )
+        if r.get("error"):
+            raise RuntimeError(f"collect shard {sid}: {r['error']}")
+        copied.append(sid)
+    r = http_json(
+        "POST",
+        f"http://{target}/admin/ec/to_volume?volume={vid}"
+        f"&collection={collection}",
+    )
+    if r.get("error"):
+        raise RuntimeError(f"decode on {target}: {r['error']}")
+    # retire the shards everywhere (the target already dropped its EC
+    # registration and files during the swap). The decode has committed,
+    # so an unreachable holder must not abort the loop — report it.
+    retire_errors = []
+    for url in counts:
+        if url == target:
+            continue
+        sids = ",".join(str(s) for s, urls in locs.items() if url in urls)
+        for ep in (
+            f"http://{url}/admin/ec/unmount?volume={vid}",
+            f"http://{url}/admin/ec/delete_shards?volume={vid}"
+            f"&collection={collection}&shards={sids}",
+        ):
+            try:
+                rr = http_json("POST", ep)
+                if rr.get("error"):
+                    retire_errors.append(f"{url}: {rr['error']}")
+            except Exception as e:  # noqa: BLE001 — keep retiring others
+                retire_errors.append(f"{url}: {e}")
+    out = {
+        "volume": vid,
+        "decoded_on": target,
+        "collected_shards": copied,
+        "dat_size": r.get("dat_size"),
+        "file_count": r.get("file_count"),
+    }
+    if retire_errors:
+        out["retire_errors"] = retire_errors
+    return out
+
+
 def ec_balance(env: CommandEnv, collection: str = "") -> dict:
     """command_ec_balance.go: even out shard counts across servers."""
     nodes = [n["url"] for n in env.data_nodes()]

@@ -20,6 +20,7 @@ HELP = """commands:
   volumeServer.evacuate -node=host:port         drain a server
   volume.fsck [-apply=true]                     find orphan needles vs filer
   ec.encode -volumeId=N [-collection=C]   erasure-code + spread a volume
+  ec.decode -volumeId=N [-collection=C]   turn an EC volume back to normal
   ec.rebuild -volumeId=N                  rebuild missing shards
   ec.balance                              even out shard spread
   collection.list | collection.delete -collection=C
@@ -98,6 +99,10 @@ def run_command(env: CommandEnv, line: str) -> object:
         return C.volume_fix_replication(env)
     if cmd == "ec.encode":
         return C.ec_encode(
+            env, int(flags["volumeId"]), flags.get("collection", "")
+        )
+    if cmd == "ec.decode":
+        return C.ec_decode(
             env, int(flags["volumeId"]), flags.get("collection", "")
         )
     if cmd == "ec.rebuild":
