@@ -448,27 +448,25 @@ class FilerServer:
                 if len(page) < limit:
                     break
             return 200, {"path": lookup, "entries": entries, "lastFileName": cursor}
+        from .http_util import (
+            parse_byte_range,
+            range_headers,
+            unsatisfiable_range_headers,
+        )
+
         total = entry.file_size()
         offset, size = 0, total
         rng = h.headers.get("Range", "")
-        ranged = False
-        if rng.startswith("bytes="):
-            spec = rng[6:].split("-")
-            if not spec[0]:  # suffix range: last N bytes
-                n = int(spec[1]) if len(spec) > 1 and spec[1] else 0
-                offset, size = max(0, total - n), min(n, total)
-            else:
-                start = int(spec[0])
-                if start >= total:
-                    return 416, {"error": f"range start {start} >= size {total}"}
-                end = int(spec[1]) if len(spec) > 1 and spec[1] else total - 1
-                offset, size = start, min(end, total - 1) - start + 1
-            ranged = True
+        parsed = parse_byte_range(rng, total) if rng else None
+        if parsed == "unsatisfiable":
+            h.extra_headers = unsatisfiable_range_headers(total)
+            return 416, {"error": f"range {rng!r} beyond size {total}"}
+        if parsed is not None:
+            start, end = parsed
+            offset, size = start, end - start + 1
         data = self._read_range(entry, offset, size)
-        if ranged:
-            h.extra_headers = {
-                "Content-Range": f"bytes {offset}-{offset + size - 1}/{total}"
-            }
+        if parsed is not None:
+            h.extra_headers = range_headers(offset, offset + size - 1, total)
             return 206, data
         return 200, data
 

@@ -82,6 +82,40 @@ class JsonHandler(BaseHTTPRequestHandler):
         self._dispatch("HEAD")
 
 
+def parse_byte_range(rng: str, total: int):
+    """Single-range 'bytes=a-b' → (start, end) inclusive; None = serve the
+    full body (absent/malformed/multi-range); 'unsatisfiable' = 416.
+    Shared by the volume and filer read paths so the RFC corner cases live
+    in one place."""
+    spec = rng.strip()
+    if not spec.startswith("bytes=") or "," in spec:
+        return None
+    start_s, _, end_s = spec[len("bytes="):].partition("-")
+    try:
+        if start_s == "":  # suffix form: last N bytes
+            start, end = max(0, total - int(end_s)), total - 1
+        else:
+            start = int(start_s)
+            end = int(end_s) if end_s else total - 1
+    except ValueError:
+        return None
+    end = min(end, total - 1)
+    if start > end or start >= total:
+        return "unsatisfiable"
+    return start, end
+
+
+def range_headers(start: int, end: int, total: int) -> dict:
+    return {
+        "Content-Range": f"bytes {start}-{end}/{total}",
+        "Accept-Ranges": "bytes",
+    }
+
+
+def unsatisfiable_range_headers(total: int) -> dict:
+    return {"Content-Range": f"bytes */{total}"}
+
+
 def start_server(
     handler_cls, host: str, port: int, ssl_context=None
 ) -> ThreadingHTTPServer:

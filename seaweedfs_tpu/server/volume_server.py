@@ -173,6 +173,7 @@ class VolumeServer:
                 # server-side chunked-file resolution
                 # (volume_server_handlers_read.go:181)
                 return self._serve_chunked_manifest(h, n, data)
+            serving_gzip = False
             if n.is_compressed:
                 # serve gzip verbatim only to clients that asked for it;
                 # everyone else gets the original bytes
@@ -180,6 +181,7 @@ class VolumeServer:
                     q.get("width") or q.get("height")
                 ):
                     h.extra_headers = {"Content-Encoding": "gzip"}
+                    serving_gzip = True
                 else:
                     from ..util.compression import ungzip_data
 
@@ -196,7 +198,39 @@ class VolumeServer:
                     int(q["height"]) if q.get("height") else None,
                     q.get("mode", ""),
                 )
+            rng = h.headers.get("Range", "")
+            if (
+                rng
+                and not (q.get("width") or q.get("height"))
+                and not serving_gzip  # ranges address the plaintext bytes
+            ):
+                return self._range_reply(h, data, rng)
+            h.extra_headers = (h.extra_headers or {}) | {
+                "Accept-Ranges": "bytes"
+            }
             return 200, data
+
+    @staticmethod
+    def _range_reply(h, data: bytes, rng: str):
+        """Single-range HTTP Range semantics over needle bytes
+        (volume_server_handlers_read.go processRangeRequest)."""
+        from .http_util import (
+            parse_byte_range,
+            range_headers,
+            unsatisfiable_range_headers,
+        )
+
+        total = len(data)
+        parsed = parse_byte_range(rng, total)
+        if parsed is None:
+            h.extra_headers = {"Accept-Ranges": "bytes"}
+            return 200, data
+        if parsed == "unsatisfiable":
+            h.extra_headers = unsatisfiable_range_headers(total)
+            return 416, b""
+        start, end = parsed
+        h.extra_headers = range_headers(start, end, total)
+        return 206, data[start : end + 1]
 
     def _serve_chunked_manifest(self, h, n, manifest_bytes: bytes):
         """Concatenate a chunked file from its manifest
@@ -213,16 +247,49 @@ class VolumeServer:
         if h.command == "HEAD":
             # answer from manifest metadata; don't materialize gigabytes
             headers["Content-Length"] = str(mf.get("size", 0))
+            headers["Accept-Ranges"] = "bytes"
             h.extra_headers = headers
             return 200, b""
-        out = bytearray(mf.get("size", 0))
+        from .http_util import (
+            parse_byte_range,
+            range_headers,
+            unsatisfiable_range_headers,
+        )
+
+        total = mf.get("size", 0)
+        rng = h.headers.get("Range", "")
+        parsed = parse_byte_range(rng, total) if rng else None
+        if parsed == "unsatisfiable":
+            h.extra_headers = unsatisfiable_range_headers(total)
+            return 416, b""
+        if parsed is not None:
+            # fetch ONLY the overlapping chunks — a ranged read of a huge
+            # chunked file must not materialize the whole thing
+            start, end = parsed
+            out = bytearray(end - start + 1)
+            for c in mf.get("chunks", []):
+                c_start, c_end = c["offset"], c["offset"] + c["size"] - 1
+                if c_end < start or c_start > end:
+                    continue
+                status, piece = self._fetch_fid(c["fid"])
+                if status != 200:
+                    return 500, {"error": f"chunk {c['fid']}: HTTP {status}"}
+                lo = max(start, c_start)
+                hi = min(end, c_end)
+                out[lo - start : hi - start + 1] = piece[
+                    lo - c_start : hi - c_start + 1
+                ]
+            headers |= range_headers(start, end, total)
+            h.extra_headers = headers
+            return 206, bytes(out)
+        out = bytearray(total)
         for c in sorted(mf.get("chunks", []), key=lambda c: c["offset"]):
             status, piece = self._fetch_fid(c["fid"])
             if status != 200:
                 return 500, {"error": f"chunk {c['fid']}: HTTP {status}"}
             out[c["offset"] : c["offset"] + len(piece)] = piece
-        if headers:
-            h.extra_headers = headers
+        headers["Accept-Ranges"] = "bytes"
+        h.extra_headers = headers
         return 200, bytes(out)
 
     def _fetch_fid(self, fid: str) -> tuple[int, bytes]:
