@@ -58,8 +58,6 @@ def upload_data(
     compress: bool = True,
     is_chunk_manifest: bool = False,
 ) -> dict:
-    import urllib.request
-
     # client-side auto-gzip by file type (upload_content.go:107-136); the
     # volume server stores the compressed bytes with FLAG_IS_COMPRESSED
     gzipped = False
@@ -71,24 +69,29 @@ def upload_data(
             if gz is not data:  # identity means it didn't pay off
                 data, gzipped = gz, True
 
-    q = f"?ttl={ttl}" if ttl else ""
-    req = urllib.request.Request(
-        f"http://{url}/{fid}{q}", data=data, method="POST"
-    )
-    if gzipped:
-        req.add_header("Content-Encoding", "gzip")
-    if is_chunk_manifest:
-        req.add_header("X-Sweed-Chunk-Manifest", "true")
-    if name:
-        req.add_header("X-Sweed-Name", name)
-    if mime:
-        req.add_header("X-Sweed-Mime", mime)
-    if jwt:
-        req.add_header("Authorization", f"Bearer {jwt}")
-    with urllib.request.urlopen(req, timeout=60) as resp:
-        import json
+    import json
 
-        return json.loads(resp.read() or b"{}")
+    from .server.http_util import http_bytes_headers
+
+    q = f"?ttl={ttl}" if ttl else ""
+    headers = {}
+    if gzipped:
+        headers["Content-Encoding"] = "gzip"
+    if is_chunk_manifest:
+        headers["X-Sweed-Chunk-Manifest"] = "true"
+    if name:
+        headers["X-Sweed-Name"] = name
+    if mime:
+        headers["X-Sweed-Mime"] = mime
+    if jwt:
+        headers["Authorization"] = f"Bearer {jwt}"
+    status, body, _ = http_bytes_headers(
+        "POST", f"http://{url}/{fid}{q}", body=data, timeout=60,
+        headers=headers,
+    )
+    if status >= 300:
+        raise RuntimeError(f"upload {fid}: HTTP {status} {body[:200]!r}")
+    return json.loads(body or b"{}")
 
 
 class LookupCache:

@@ -872,6 +872,7 @@ class S3ApiServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True  # keep-alive + Nagle = ~40ms RTTs
 
             def log_message(self, fmt, *args):
                 pass

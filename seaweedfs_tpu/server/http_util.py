@@ -17,6 +17,11 @@ class JsonHandler(BaseHTTPRequestHandler):
     [(method, path_prefix, fn)] where fn(handler, path, query, body) →
     (status, payload). Payload bytes pass through; anything else is JSON."""
 
+    # headers and body go out as separate writes; on keep-alive
+    # connections Nagle + the peer's delayed ACK turns that into ~40ms
+    # per response
+    disable_nagle_algorithm = True
+
     protocol_version = "HTTP/1.1"
     routes: list[tuple[str, str, Callable]] = []
     server_ctx: Any = None
@@ -116,15 +121,54 @@ def unsatisfiable_range_headers(total: int) -> dict:
     return {"Content-Range": f"bytes */{total}"}
 
 
+class _TrackingThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that severs live keep-alive connections on
+    shutdown. Without this a 'stopped' server keeps answering requests on
+    established connections (handler threads block in readline forever) —
+    clients with pooled connections then talk to a ghost."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self._live_conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._live_conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._live_conns.discard(request)
+        super().shutdown_request(request)
+
+    def shutdown(self):
+        super().shutdown()
+        import socket as _socket
+
+        with self._conns_lock:
+            conns = list(self._live_conns)
+            self._live_conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
 def start_server(
     handler_cls, host: str, port: int, ssl_context=None
 ) -> ThreadingHTTPServer:
     if ssl_context is None:
-        srv = ThreadingHTTPServer((host, port), handler_cls)
+        srv = _TrackingThreadingHTTPServer((host, port), handler_cls)
     else:
         import ssl as _ssl
 
-        class _TlsServer(ThreadingHTTPServer):
+        class _TlsServer(_TrackingThreadingHTTPServer):
             """Handshake in the WORKER thread with a deadline — wrapping the
             listening socket would run handshakes inside the single accept
             loop, letting one stalled client freeze the whole server."""
@@ -142,12 +186,117 @@ def start_server(
                     except OSError:
                         pass
                     return
-                self.RequestHandlerClass(tls_conn, client_address, self)
+                # wrap_socket DETACHED the raw socket we tracked in
+                # process_request — track the live TLS socket instead or
+                # shutdown() severs a dead fd and the ghost lives on
+                with self._conns_lock:
+                    self._live_conns.discard(request)
+                    self._live_conns.add(tls_conn)
+                try:
+                    self.RequestHandlerClass(tls_conn, client_address, self)
+                finally:
+                    with self._conns_lock:
+                        self._live_conns.discard(tls_conn)
+                    try:
+                        tls_conn.close()
+                    except OSError:
+                        pass
 
         srv = _TlsServer((host, port), handler_cls)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
+
+
+# -- pooled keep-alive transport ---------------------------------------------
+# Every daemon talks HTTP/1.1; opening a fresh TCP connection per request
+# (urllib's behavior) costs a handshake on the hottest paths — assigns,
+# uploads, heartbeats, chunk fetches, replication fan-out. Connections are
+# pooled per (host, port) in thread-local storage (http.client connections
+# are not thread-safe) and re-dialed once when a pooled socket went stale
+# (peer restarted / idle-closed).
+_pool_local = threading.local()
+
+
+class _NoDelayHTTPConnection:
+    """Created lazily to keep module import light."""
+
+    _cls = None
+
+    @classmethod
+    def get(cls):
+        if cls._cls is None:
+            import http.client
+            import socket as _socket
+
+            class _Conn(http.client.HTTPConnection):
+                def connect(self):
+                    super().connect()
+                    # Nagle + delayed-ACK on a reused connection turns
+                    # every small request into a ~40ms round trip
+                    self.sock.setsockopt(
+                        _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+                    )
+
+            cls._cls = _Conn
+        return cls._cls
+
+
+def _pooled_request(
+    method: str,
+    url: str,
+    body: Optional[bytes],
+    headers: Optional[dict],
+    timeout: float,
+) -> tuple[int, bytes, dict]:
+    import http.client
+
+    u = urllib.parse.urlsplit(url)
+    key = (u.hostname, u.port)
+    conns = getattr(_pool_local, "conns", None)
+    if conns is None:
+        conns = _pool_local.conns = {}
+    path = u.path + (f"?{u.query}" if u.query else "")
+    last_err: Optional[Exception] = None
+    for attempt in (0, 1):
+        conn = conns.get(key)
+        fresh = conn is None
+        if fresh:
+            conn = _NoDelayHTTPConnection.get()(
+                u.hostname, u.port, timeout=timeout
+            )
+            conns[key] = conn
+        elif conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            resp_headers = dict(resp.getheaders())
+            if resp.will_close:
+                conn.close()
+                conns.pop(key, None)
+            return resp.status, data, resp_headers
+        except (
+            http.client.RemoteDisconnected,
+            http.client.BadStatusLine,
+            ConnectionResetError,
+            BrokenPipeError,
+        ) as e:
+            # idle-close race on a REUSED socket: the peer closed before
+            # sending a status line — safe to re-dial once. Timeouts and
+            # mid-response failures are NOT retried (the request may have
+            # executed; re-sending would double-assign/double-publish).
+            conn.close()
+            conns.pop(key, None)
+            last_err = e
+            if fresh or attempt:
+                raise
+        except (http.client.HTTPException, OSError):
+            conn.close()
+            conns.pop(key, None)
+            raise
+    raise last_err  # unreachable; keeps type checkers honest
 
 
 def http_json(
@@ -164,15 +313,21 @@ def http_json(
             headers["Content-Type"] = "application/json"
         else:
             data = body
-    req = urllib.request.Request(url, data=data, method=method, headers=headers)
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read() or b"{}")
-    except urllib.error.HTTPError as e:
+    # unreachable peers raise (like urllib's URLError did) — callers treat
+    # that as a dead node; only HTTP-level errors come back as dicts.
+    # http_bytes_headers pools http:// and falls back to urllib for https.
+    status, payload, _ = http_bytes_headers(
+        method, url, body=data, timeout=timeout, headers=headers
+    )
+    if status >= 400:
         try:
-            return json.loads(e.read() or b"{}") | {"_status": e.code}
+            return json.loads(payload or b"{}") | {"_status": status}
         except json.JSONDecodeError:
-            return {"error": str(e), "_status": e.code}
+            return {
+                "error": payload[:200].decode("utf-8", "replace"),
+                "_status": status,
+            }
+    return json.loads(payload or b"{}")
 
 
 def http_bytes(
@@ -197,6 +352,9 @@ def http_bytes_headers(
 ) -> tuple[int, bytes, dict]:
     """Like http_bytes but also returns response headers (some admin
     endpoints carry metadata such as X-Compaction-Revision there)."""
+    if url.startswith("http://"):
+        return _pooled_request(method, url, body, headers, timeout)
+    # https (or anything else) stays on urllib with its default TLS context
     req = urllib.request.Request(
         url, data=body, method=method, headers=headers or {}
     )

@@ -221,6 +221,7 @@ class WebDavServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True  # keep-alive + Nagle = ~40ms RTTs
 
             def log_message(self, fmt, *args):
                 pass

@@ -198,7 +198,58 @@ def test_tls_misconfig_and_combined_pem(certs, tmp_path):
     assert ctx.verify_mode == ssl.CERT_NONE
 
 
-def test_plain_tls_without_ca_allows_any_client(tmp_path, certs):
+def test_stopped_tls_server_severs_keepalive(tmp_path, certs):
+    """After stop(), pooled keep-alive TLS connections must die — a
+    'stopped' server answering on old connections is a ghost."""
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp_path / "v")], port=free_port(), master_url=master.url,
+        max_volume_count=10, pulse_seconds=0.5,
+    ).start()
+    filer = FilerServer(port=free_port(), master_url=master.url).start()
+    api = S3ApiServer(
+        port=free_port(), filer_url=filer.url,
+        tls_cert=certs["server_crt"], tls_key=certs["server_key"],
+    ).start()
+    try:
+        time.sleep(0.4)
+        # one persistent TLS connection, kept open across stop()
+        ctx = wtls.client_context(certs["ca"])
+        ctx.check_hostname = False
+        raw = socket.create_connection(("127.0.0.1", api.port), timeout=10)
+        tls = ctx.wrap_socket(raw)
+
+        def full_response(sock) -> bytes:
+            # drain headers + Content-Length body so nothing of response #1
+            # lingers to masquerade as a ghost answer
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                buf += sock.recv(65536)
+            head, _, rest = buf.partition(b"\r\n\r\n")
+            clen = 0
+            for line in head.split(b"\r\n"):
+                if line.lower().startswith(b"content-length:"):
+                    clen = int(line.split(b":")[1])
+            while len(rest) < clen:
+                rest += sock.recv(65536)
+            return head + b"\r\n\r\n" + rest
+
+        tls.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+        assert full_response(tls).startswith(b"HTTP/1.1")
+        api.stop()
+        time.sleep(0.3)
+        try:
+            tls.settimeout(5)
+            tls.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+            ghost = tls.recv(65536)
+        except (OSError, ssl.SSLError):
+            ghost = b""
+        assert ghost == b"", f"stopped server still answered: {ghost[:60]!r}"
+        tls.close()
+    finally:
+        filer.stop()
+        volume.stop()
+        master.stop()
     """cert/key without -caCert = ordinary https (no client certs)."""
     master = MasterServer(port=free_port(), node_timeout=60).start()
     volume = VolumeServer(
