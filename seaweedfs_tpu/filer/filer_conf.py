@@ -62,3 +62,46 @@ class FilerConf:
                 if len(rule.location_prefix) > len(best.location_prefix):
                     best = rule
         return best
+
+    # -- editing (fs.configure / command_fs_configure.go) --------------------
+    def set_rule(
+        self,
+        location_prefix: str,
+        collection: str = "",
+        replication: str = "",
+        ttl: str = "",
+        fsync: bool = False,
+    ) -> None:
+        """Upsert the rule for a prefix (AddLocationConf semantics)."""
+        self.delete_prefix(location_prefix)
+        self.locations.append(
+            PathConf(
+                location_prefix=location_prefix,
+                collection=collection,
+                replication=replication,
+                ttl=ttl,
+                fsync=fsync,
+            )
+        )
+
+    def delete_prefix(self, location_prefix: str) -> None:
+        self.locations = [
+            r for r in self.locations if r.location_prefix != location_prefix
+        ]
+
+    def to_dict(self) -> dict:
+        return {
+            "locations": [
+                {
+                    "location_prefix": r.location_prefix,
+                    "collection": r.collection,
+                    "replication": r.replication,
+                    "ttl": r.ttl,
+                    "fsync": r.fsync,
+                }
+                for r in self.locations
+            ]
+        }
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.to_dict(), indent=2).encode()

@@ -317,6 +317,18 @@ class MasterServer:
         return 200, self.master.location_deltas(since, timeout)
 
     # -- liveness reaping (master_grpc_server.go:22-50 on stream close) ------
+    def _h_leave(self, h, path, q, body):
+        """A volume server announces a graceful leave: deregister now
+        instead of waiting out the liveness timeout
+        (VolumeServerLeave → master_grpc_server stream close)."""
+        url = q.get("url", "")
+        with self._lock:
+            dn = self._nodes.pop(url, None)
+        if dn is None:
+            return 404, {"error": f"unknown node {url}"}
+        self.master.handle_node_disconnect(dn)
+        return 200, {"left": url}
+
     def _reap_loop(self):
         while not self._stop.wait(self.node_timeout / 3):
             now = time.time()
@@ -356,6 +368,7 @@ class MasterServer:
                 ("GET", "/col/list", ms._leader_only(ms._h_collections)),
                 ("GET", "/cluster/watch", ms._leader_only(ms._h_watch)),
                 ("POST", "/cluster/heartbeat", ms._h_heartbeat),
+                ("POST", "/cluster/leave", ms._h_leave),
                 ("GET", "/cluster/ping", ms._h_ping),
                 ("POST", "/cluster/leader_beat", ms._h_leader_beat),
                 ("POST", "/cluster/vote", ms._h_vote),

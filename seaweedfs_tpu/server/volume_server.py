@@ -726,6 +726,69 @@ class VolumeServer:
         self.store.queue_new_volume(v)
         return 200, {}
 
+    def _h_volume_unmount(self, h, path, q, body):
+        """VolumeUnmount: drop the volume from serving, keep its files
+        (volume_grpc_admin.go VolumeUnmount)."""
+        vid = int(q["volume"])
+        if self.store.unmount_volume(vid):
+            return 200, {"unmounted": vid}
+        return 404, {"error": "volume not found"}
+
+    def _h_volume_mount(self, h, path, q, body):
+        """VolumeMount: (re)load ONE volume from disk and announce it —
+        other deliberately-unmounted volumes in the directory stay down."""
+        vid = int(q["volume"])
+        already = self.store.find_volume(vid) is not None
+        v = self.store.mount_volume(vid)
+        if v is None:
+            return 404, {"error": f"no volume {vid} files on disk"}
+        return 200, {"mounted": vid, "already": already}
+
+    def _h_volume_configure_replication(self, h, path, q, body):
+        """VolumeConfigure: rewrite the superblock's replica-placement byte
+        (volume_grpc_admin.go VolumeConfigure,
+        command_volume_configure_replication.go)."""
+        from ..storage.replica_placement import ReplicaPlacement
+
+        vid = int(q["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return 404, {"error": "volume not found"}
+        rp = ReplicaPlacement.from_string(q.get("replication", "000"))
+        with v._lock:
+            old = v.super_block.replica_placement
+            v.super_block.replica_placement = rp
+            try:
+                v.data_backend.write_at(0, v.super_block.to_bytes())
+                v.data_backend.sync()
+            except Exception:
+                # persist-or-nothing: a failed write must not leave memory
+                # advertising a placement the disk never got
+                v.super_block.replica_placement = old
+                raise
+        # re-announce with the new placement
+        self.store.queue_new_volume(v)
+        return 200, {"volume": vid, "replication": str(rp)}
+
+    def _h_server_leave(self, h, path, q, body):
+        """VolumeServerLeave: stop heartbeating and deregister from the
+        master immediately (volume_grpc_admin.go VolumeServerLeave)."""
+        self._stop.set()
+        self.store.delta_event.set()  # wake the beat loop so it exits
+        # an in-flight beat landing AFTER the master processes the leave
+        # would re-register us as a ghost — wait the loop out first
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=12)
+        try:
+            http_json(
+                "POST",
+                f"http://{self.master_url}/cluster/leave"
+                f"?url={self.host}:{self.port}",
+            )
+        except Exception as e:  # noqa: BLE001 — master may be down
+            glog.warning("leave notify failed: %s", e)
+        return 200, {"left": f"{self.host}:{self.port}"}
+
     def _h_ec_to_volume(self, h, path, q, body):
         """VolumeEcShardsToVolume (volume_grpc_erasure_coding.go): decode
         the local shards back into a normal .dat/.idx volume and serve it."""
@@ -1025,6 +1088,11 @@ class VolumeServer:
                 ("POST", "/admin/ec/rebuild", vs._h_ec_rebuild),
                 ("POST", "/admin/ec/copy", vs._h_ec_copy),
                 ("GET", "/admin/ec/shard_read", vs._h_ec_shard_read),
+                ("POST", "/admin/volume_unmount", vs._h_volume_unmount),
+                ("POST", "/admin/volume_mount", vs._h_volume_mount),
+                ("POST", "/admin/volume_configure_replication",
+                 vs._h_volume_configure_replication),
+                ("POST", "/admin/server_leave", vs._h_server_leave),
                 ("POST", "/admin/ec/to_volume", vs._h_ec_to_volume),
                 ("POST", "/admin/ec/mount", vs._h_ec_mount),
                 ("POST", "/admin/ec/unmount", vs._h_ec_unmount),

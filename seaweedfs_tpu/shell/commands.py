@@ -433,6 +433,71 @@ def volume_tier_download(env: CommandEnv, vid: int) -> dict:
 
 # -- volume move / balance / evacuate (command_volume_balance.go,
 #    command_volume_move.go, command_volume_server_evacuate.go) -------------
+def volume_copy(
+    env: CommandEnv, vid: int, target: str, source: str = ""
+) -> dict:
+    """Add a replica: copy a volume to target without deleting the source
+    (command_volume_copy.go)."""
+    locs = env.volume_locations(vid)
+    if not locs:
+        raise RuntimeError(f"volume {vid} has no locations")
+    if source and source not in locs:
+        raise RuntimeError(f"{source} does not hold volume {vid}")
+    source = source or locs[0]
+    if target in locs:
+        raise RuntimeError(f"{target} already holds volume {vid}")
+    collection = _volume_collection(env, vid)
+    if not _copy_volume(env, vid, source, target, collection):
+        raise RuntimeError(f"copy {vid} {source}→{target} failed")
+    return {"volume": vid, "copied_from": source, "to": target}
+
+
+def volume_unmount(env: CommandEnv, vid: int, node: str) -> dict:
+    """Stop serving a volume, keep its files (command_volume_unmount.go)."""
+    r = http_json("POST", f"http://{node}/admin/volume_unmount?volume={vid}")
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return r
+
+
+def volume_mount(env: CommandEnv, vid: int, node: str) -> dict:
+    """(Re)load a volume from the node's disk (command_volume_mount.go)."""
+    r = http_json("POST", f"http://{node}/admin/volume_mount?volume={vid}")
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return r
+
+
+def volume_configure_replication(
+    env: CommandEnv, vid: int, replication: str
+) -> dict:
+    """Rewrite a volume's replica placement on every replica
+    (command_volume_configure_replication.go)."""
+    locs = env.volume_locations(vid)
+    if not locs:
+        raise RuntimeError(f"volume {vid} has no locations")
+    results = []
+    for loc in locs:
+        r = http_json(
+            "POST",
+            f"http://{loc}/admin/volume_configure_replication"
+            f"?volume={vid}&replication={replication}",
+        )
+        if r.get("error"):
+            raise RuntimeError(f"{loc}: {r['error']}")
+        results.append({"server": loc} | r)
+    return {"configured": results}
+
+
+def volume_server_leave(env: CommandEnv, node: str) -> dict:
+    """Gracefully deregister a volume server
+    (command_volume_server_leave.go)."""
+    r = http_json("POST", f"http://{node}/admin/server_leave")
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return r
+
+
 def volume_move(
     env: CommandEnv, vid: int, target: str, source: str = ""
 ) -> dict:
@@ -735,6 +800,80 @@ def fs_ls(env: CommandEnv, path: Optional[str] = None) -> list[dict]:
     if not r.get("is_directory"):
         return [r]  # a file
     return _list_dir(env.filer, target)
+
+
+def fs_pwd(env: CommandEnv) -> str:
+    """command_fs_pwd.go."""
+    return getattr(env, "cwd", "/") or "/"
+
+
+def fs_cat(env: CommandEnv, path: str) -> str:
+    """Print a file's content (command_fs_cat.go)."""
+    from ..server.http_util import http_bytes
+
+    target = _fs_resolve(env, path)
+    status, body = http_bytes("GET", f"http://{env.filer}{target}")
+    if status != 200:
+        raise RuntimeError(f"cat {target}: HTTP {status}")
+    return body.decode("utf-8", "replace")
+
+
+def fs_mv(env: CommandEnv, src: str, dst: str) -> dict:
+    """Atomic server-side move/rename of a file or whole directory
+    (command_fs_mv.go → AtomicRenameEntry)."""
+    s, d = _fs_resolve(env, src), _fs_resolve(env, dst)
+    r = http_json("POST", f"http://{env.filer}{s}?mv.to={d}")
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return {"moved": s, "to": d}
+
+
+def fs_meta_cat(env: CommandEnv, path: str) -> dict:
+    """One entry's full metadata as JSON (command_fs_meta_cat.go)."""
+    target = _fs_resolve(env, path)
+    r = http_json("GET", f"http://{env.filer}{target}?meta=true")
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return r
+
+
+def fs_configure(
+    env: CommandEnv,
+    location_prefix: str = "",
+    collection: str = "",
+    replication: str = "",
+    ttl: str = "",
+    fsync: bool = False,
+    apply: bool = False,
+    delete: bool = False,
+) -> dict:
+    """Read or update the path-prefix storage rules the filer applies to
+    uploads (command_fs_configure.go → /etc/seaweedfs/filer.conf)."""
+    from ..filer.filer_conf import FILER_CONF_PATH, FilerConf
+    from ..server.http_util import http_bytes
+
+    status, raw = http_bytes("GET", f"http://{env.filer}{FILER_CONF_PATH}")
+    conf = FilerConf.from_bytes(raw) if status == 200 and raw else FilerConf()
+    if location_prefix:
+        if delete:
+            conf.delete_prefix(location_prefix)
+        else:
+            conf.set_rule(
+                location_prefix,
+                collection=collection,
+                replication=replication,
+                ttl=ttl,
+                fsync=fsync,
+            )
+        if apply:
+            st, _ = http_bytes(
+                "PUT",
+                f"http://{env.filer}{FILER_CONF_PATH}",
+                conf.to_bytes(),
+            )
+            if st not in (200, 201):
+                raise RuntimeError(f"writing filer.conf: HTTP {st}")
+    return conf.to_dict()
 
 
 def fs_du(env: CommandEnv, path: Optional[str] = None) -> dict:

@@ -16,16 +16,25 @@ HELP = """commands:
   volume.mark.readonly -volumeId=N    seal a volume
   volume.fix.replication              re-replicate under-replicated volumes
   volume.move -volumeId=N -target=host:port [-source=host:port]
+  volume.copy -volumeId=N -target=host:port [-source=host:port]
+  volume.mount|volume.unmount -volumeId=N -node=host:port
+  volume.configure.replication -volumeId=N -replication=XYZ
+  volume.tier.upload -volumeId=N [-backend=s3.default|-endpoint=..] [-bucket=B]
+  volume.tier.download -volumeId=N
   volume.balance [-collection=C] [-force=true]  plan (and apply) even spread
   volumeServer.evacuate -node=host:port         drain a server
+  volumeServer.leave -node=host:port            deregister a server now
   volume.fsck [-apply=true]                     find orphan needles vs filer
   ec.encode -volumeId=N [-collection=C]   erasure-code + spread a volume
   ec.decode -volumeId=N [-collection=C]   turn an EC volume back to normal
   ec.rebuild -volumeId=N                  rebuild missing shards
   ec.balance                              even out shard spread
   collection.list | collection.delete -collection=C
-  fs.cd PATH | fs.ls [PATH] | fs.du [PATH] | fs.tree [PATH]
+  fs.cd PATH | fs.pwd | fs.ls [PATH] | fs.du [PATH] | fs.tree [PATH]
+  fs.cat FILE | fs.mv SRC DST | fs.meta.cat FILE
   fs.meta.save -o=FILE [PATH] | fs.meta.load -i=FILE
+  fs.configure [-locationPrefix=/p/ -collection=C -replication=XYZ
+                -ttl=T -apply=true|-delete=true]
   bucket.list | bucket.create -name=B | bucket.delete -name=B
   lock | unlock
   help | exit
@@ -65,6 +74,49 @@ def run_command(env: CommandEnv, line: str) -> object:
         return C.volume_server_evacuate(env, flags["node"])
     if cmd == "volume.fsck":
         return C.volume_fsck(env, env.filer, apply=flags.get("apply") == "true")
+    if cmd == "volume.copy":
+        return C.volume_copy(
+            env, int(flags["volumeId"]), flags["target"],
+            flags.get("source", ""),
+        )
+    if cmd == "volume.mount":
+        return C.volume_mount(env, int(flags["volumeId"]), flags["node"])
+    if cmd == "volume.unmount":
+        return C.volume_unmount(env, int(flags["volumeId"]), flags["node"])
+    if cmd == "volume.configure.replication":
+        return C.volume_configure_replication(
+            env, int(flags["volumeId"]), flags["replication"]
+        )
+    if cmd == "volumeServer.leave":
+        return C.volume_server_leave(env, flags["node"])
+    if cmd == "volume.tier.upload":
+        return C.volume_tier_upload(
+            env, int(flags["volumeId"]), flags.get("endpoint", ""),
+            flags.get("bucket", "tier"),
+            keep_local=flags.get("keepLocal") == "true",
+            backend=flags.get("backend", ""),
+        )
+    if cmd == "volume.tier.download":
+        return C.volume_tier_download(env, int(flags["volumeId"]))
+    if cmd == "fs.pwd":
+        return C.fs_pwd(env)
+    if cmd == "fs.cat":
+        return C.fs_cat(env, args[0])
+    if cmd == "fs.mv":
+        return C.fs_mv(env, args[0], args[1])
+    if cmd == "fs.meta.cat":
+        return C.fs_meta_cat(env, args[0])
+    if cmd == "fs.configure":
+        return C.fs_configure(
+            env,
+            location_prefix=flags.get("locationPrefix", ""),
+            collection=flags.get("collection", ""),
+            replication=flags.get("replication", ""),
+            ttl=flags.get("ttl", ""),
+            fsync=flags.get("fsync") == "true",
+            apply=flags.get("apply") == "true",
+            delete=flags.get("delete") == "true",
+        )
     if cmd == "fs.cd":
         return C.fs_cd(env, args[0] if args else "/")
     if cmd == "fs.ls":

@@ -11,6 +11,7 @@ stream, and the EC read path with on-the-fly reconstruction:
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import deque
 from typing import Callable, Optional
@@ -125,6 +126,48 @@ class Store:
                 self.delta_event.set()
                 return True
         return False
+
+    def unmount_volume(self, vid: int) -> bool:
+        """Stop serving a volume but keep its files on disk, announcing the
+        removal like delete_volume does (VolumeUnmount)."""
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        msg = self._volume_message(v)
+        for loc in self.locations:
+            if loc.unload_volume(vid):
+                with self._lock:
+                    self.deleted_volumes.append(msg)
+                self.delta_event.set()
+                return True
+        return False
+
+    def mount_volume(self, vid: int) -> Optional[Volume]:
+        """(Re)load exactly one volume from disk — not every unmounted
+        volume sharing the directory — and announce it."""
+        from .disk_location import parse_volume_base_name
+
+        if self.find_volume(vid) is not None:
+            return self.find_volume(vid)
+        for loc in self.locations:
+            for name in os.listdir(loc.directory):
+                if not name.endswith(".dat"):
+                    continue
+                try:
+                    collection, v_id = parse_volume_base_name(name[:-4])
+                except ValueError:
+                    continue
+                if v_id != vid:
+                    continue
+                v = Volume(
+                    loc.directory, collection, vid,
+                    create_if_missing=False,
+                    needle_map_kind=loc.needle_map_kind,
+                )
+                loc.add_volume(v)
+                self.queue_new_volume(v)
+                return v
+        return None
 
     # -- delta beat plumbing -------------------------------------------------
     def queue_new_volume(self, v: Volume) -> None:

@@ -238,3 +238,97 @@ def test_repl_dispatch(trio):
     out = run_command(env, "volume.balance")
     assert "plan" in out and out["moved"] == []
     assert "unknown command" in run_command(env, "bogus.cmd")
+
+
+def test_volume_copy_mount_unmount_configure(trio):
+    master, servers, env = trio
+    fid = operation.submit(master.url, b"admin ops payload")
+    vid = int(fid.split(",")[0])
+    locs = env.volume_locations(vid)
+    source = locs[0]
+    target = next(
+        f"{s.host}:{s.port}" for s in servers
+        if f"{s.host}:{s.port}" not in locs
+    )
+    # volume.copy adds a replica without removing the source
+    res = run_command(env, f"volume.copy -volumeId={vid} -target={target}")
+    assert res["to"] == target
+    time.sleep(0.8)
+    locs2 = env.volume_locations(vid)
+    assert source in locs2 and target in locs2
+    # volume.unmount keeps files but stops serving
+    res = run_command(env, f"volume.unmount -volumeId={vid} -node={target}")
+    assert res["unmounted"] == vid
+    time.sleep(0.8)
+    assert target not in env.volume_locations(vid)
+    # volume.mount brings it back from disk
+    res = run_command(env, f"volume.mount -volumeId={vid} -node={target}")
+    assert res["mounted"] == vid
+    time.sleep(0.8)
+    assert target in env.volume_locations(vid)
+    # volume.configure.replication rewrites the superblock on every replica
+    res = run_command(
+        env, f"volume.configure.replication -volumeId={vid} -replication=001"
+    )
+    assert all(r["replication"] == "001" for r in res["configured"])
+    for s in servers:
+        v = s.store.find_volume(vid)
+        if v is not None:
+            assert str(v.super_block.replica_placement) == "001"
+    # data still readable through it all
+    assert operation.download(master.url, fid) == b"admin ops payload"
+
+
+def test_volume_server_leave(trio):
+    master, servers, env = trio
+    operation.submit(master.url, b"leave test")
+    assert len(env.data_nodes()) == 3
+    victim = f"{servers[2].host}:{servers[2].port}"
+    res = run_command(env, f"volumeServer.leave -node={victim}")
+    assert res["left"] == victim
+    deadline = time.time() + 5
+    while time.time() < deadline and len(env.data_nodes()) != 2:
+        time.sleep(0.1)
+    assert len(env.data_nodes()) == 2
+    assert victim not in {n["url"] for n in env.data_nodes()}
+
+
+def test_fs_cat_mv_pwd_meta_cat(filer_cluster):
+    master, vs, fs, env = filer_cluster
+    put_file(fs.url, "/docs/readme.txt", b"hello shell")
+    assert run_command(env, "fs.pwd") == "/"
+    run_command(env, "fs.cd /docs")
+    assert run_command(env, "fs.pwd") == "/docs"
+    assert run_command(env, "fs.cat readme.txt") == "hello shell"
+    meta = run_command(env, "fs.meta.cat readme.txt")
+    assert meta["full_path"] == "/docs/readme.txt" and meta["chunks"]
+    res = run_command(env, "fs.mv readme.txt /docs/renamed.txt")
+    assert res["to"] == "/docs/renamed.txt"
+    assert run_command(env, "fs.cat /docs/renamed.txt") == "hello shell"
+    names = {e["name"] for e in C.fs_ls(env, "/docs")}
+    assert names == {"renamed.txt"}
+
+
+def test_fs_configure_rules(filer_cluster):
+    master, vs, fs, env = filer_cluster
+    res = run_command(
+        env,
+        "fs.configure -locationPrefix=/buckets/media/ -collection=media "
+        "-ttl=30d -apply=true",
+    )
+    assert res["locations"][0]["collection"] == "media"
+    # the rule is persisted in the filer and visible on re-read
+    res = run_command(env, "fs.configure")
+    assert any(
+        r["location_prefix"] == "/buckets/media/" for r in res["locations"]
+    )
+    # the filer applies it to new uploads under the prefix (FilerConf reload)
+    time.sleep(0.5)
+    rule = fs.filer_conf.match_storage_rule("/buckets/media/x.jpg")
+    assert rule.collection == "media" and rule.ttl == "30d"
+    # delete the rule
+    res = run_command(
+        env, "fs.configure -locationPrefix=/buckets/media/ -delete=true "
+        "-apply=true"
+    )
+    assert res["locations"] == []
