@@ -876,6 +876,69 @@ def fs_configure(
     return conf.to_dict()
 
 
+def fs_meta_notify(env: CommandEnv, path: Optional[str] = None) -> dict:
+    """Re-publish every entry under a path as a create event to the
+    notification.toml queue (command_fs_meta_notify.go) — seeds a fresh
+    replication consumer with the existing tree.
+
+    Events carry FULL metadata (meta=true walk — a summary listing has no
+    chunks, which a Replicator consumer would turn into zero-byte files)
+    in the same envelope shape the NotificationBus emits."""
+    import time as _time
+
+    from ..replication.notification import make_queue
+    from ..util.config import load_configuration
+
+    queue = make_queue(load_configuration("notification"))
+    if queue is None:
+        raise RuntimeError("notification.toml: no queue enabled")
+    target = _fs_resolve(env, path)
+    probe = http_json("GET", f"http://{env.filer}{target}?meta=true")
+    if probe.get("error"):
+        raise RuntimeError(f"{target}: {probe['error']}")
+    if "entries" not in probe and not probe.get("is_directory"):
+        raise RuntimeError(f"{target} is not a directory")
+    dirs = files = 0
+
+    def emit(child: str, entry: dict) -> None:
+        queue.send(
+            child,
+            {
+                "ts_ns": _time.time_ns(),
+                "directory": child.rsplit("/", 1)[0] or "/",
+                "old_entry": None,
+                "new_entry": entry | {"full_path": child},
+                "delete_chunks": False,
+            },
+        )
+
+    def walk(p: str) -> None:
+        nonlocal dirs, files
+        page_size = 1000
+        cursor = ""
+        while True:
+            r = http_json(
+                "GET",
+                f"http://{env.filer}{p.rstrip('/')}/?limit={page_size}"
+                f"&meta=true&lastFileName={cursor}",
+            )
+            entries = r.get("entries", [])
+            for e in entries:
+                child = p.rstrip("/") + "/" + e["name"]
+                emit(child, e)
+                if e.get("is_directory"):
+                    dirs += 1
+                    walk(child)
+                else:
+                    files += 1
+            if len(entries) < page_size:
+                return
+            cursor = r.get("lastFileName", "") or entries[-1]["name"]
+
+    walk(target)
+    return {"path": target, "notified_dirs": dirs, "notified_files": files}
+
+
 def fs_du(env: CommandEnv, path: Optional[str] = None) -> dict:
     """Recursive usage: bytes/files/dirs under path (command_fs_du.go)."""
     target = _fs_resolve(env, path)

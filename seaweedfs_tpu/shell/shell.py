@@ -32,7 +32,7 @@ HELP = """commands:
   collection.list | collection.delete -collection=C
   fs.cd PATH | fs.pwd | fs.ls [PATH] | fs.du [PATH] | fs.tree [PATH]
   fs.cat FILE | fs.mv SRC DST | fs.meta.cat FILE
-  fs.meta.save -o=FILE [PATH] | fs.meta.load -i=FILE
+  fs.meta.save -o=FILE [PATH] | fs.meta.load -i=FILE | fs.meta.notify [PATH]
   fs.configure [-locationPrefix=/p/ -collection=C -replication=XYZ
                 -ttl=T -apply=true|-delete=true]
   bucket.list | bucket.create -name=B | bucket.delete -name=B
@@ -125,6 +125,8 @@ def run_command(env: CommandEnv, line: str) -> object:
         return C.fs_du(env, args[0] if args else None)
     if cmd == "fs.tree":
         return C.fs_tree(env, args[0] if args else None)
+    if cmd == "fs.meta.notify":
+        return C.fs_meta_notify(env, args[0] if args else None)
     if cmd == "fs.meta.save":
         return C.fs_meta_save(env, flags["o"], args[0] if args else None)
     if cmd == "fs.meta.load":

@@ -332,3 +332,36 @@ def test_fs_configure_rules(filer_cluster):
         "-apply=true"
     )
     assert res["locations"] == []
+
+
+def test_fs_meta_notify(filer_cluster, tmp_path, monkeypatch):
+    master, vs, fs, env = filer_cluster
+    put_file(fs.url, "/seed/one.txt", b"1")
+    put_file(fs.url, "/seed/sub/two.txt", b"22")
+    events = str(tmp_path / "events.jsonl")
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "notification.toml").write_text(
+        f'[notification.file]\nenabled = true\npath = "{events}"\n'
+    )
+    res = run_command(env, "fs.meta.notify /seed")
+    assert res["notified_files"] == 2 and res["notified_dirs"] == 1
+    import json as _json
+
+    lines = [
+        _json.loads(ln) for ln in open(events) if ln.strip()
+    ]
+    keys = {e["key"] for e in lines}
+    assert {"/seed/one.txt", "/seed/sub", "/seed/sub/two.txt"} == keys
+    for e in lines:
+        msg = e["message"]
+        # full NotificationBus envelope, with chunk-bearing metadata so a
+        # Replicator consumer can fetch real content
+        assert set(msg) == {
+            "ts_ns", "directory", "old_entry", "new_entry", "delete_chunks",
+        }
+        assert msg["new_entry"]["full_path"] in keys
+        if not msg["new_entry"].get("is_directory"):
+            assert msg["new_entry"]["chunks"], msg["new_entry"]
+    # a file target errors cleanly instead of crashing
+    with pytest.raises(RuntimeError, match="not a directory"):
+        C.fs_meta_notify(env, "/seed/one.txt")
