@@ -121,31 +121,26 @@ def write_ec_files(
             o.close()
 
 
-def _encode_pipelined(dat, items, codec, outputs, dat_size: int) -> None:
+def _overlap_pipeline(produce, compute, consume) -> None:
+    """Three-stage overlap shared by encode and rebuild: a reader thread
+    runs `produce` (an iterator of host chunks), the main thread runs
+    `compute` (async device dispatch), a writer thread runs `consume`
+    (blocks on device results, writes files). Bounded queues give ~2
+    chunks of lookahead; any stage failing drains the others so every
+    thread exits and the first error is re-raised."""
     import queue
     import threading
 
-    k, m = codec.data_shards, codec.parity_shards
-    align = codec.alignment() if hasattr(codec, "alignment") else 1
     read_q: queue.Queue = queue.Queue(maxsize=2)
     write_q: queue.Queue = queue.Queue(maxsize=2)
     errors: list[BaseException] = []
 
     def reader():
         try:
-            with open(dat, "rb") as f:
-                for it in items:
-                    if errors:
-                        return
-                    start, block_size, col, width = it
-                    read_q.put(
-                        (
-                            it,
-                            _read_block_columns(
-                                f, start, block_size, col, width, k, dat_size
-                            ),
-                        )
-                    )
+            for item in produce():
+                if errors:
+                    return
+                read_q.put(item)
         except BaseException as e:  # surfaced after join
             errors.append(e)
         finally:
@@ -157,15 +152,10 @@ def _encode_pipelined(dat, items, codec, outputs, dat_size: int) -> None:
                 got = write_q.get()
                 if got is None:
                     return
-                (_, _, _, width), data, parity_dev = got
-                parity = np.asarray(parity_dev)[:, :width]  # blocks until ready
-                for i in range(k):
-                    outputs[i].write(data[i, :width].tobytes())
-                for j in range(m):
-                    outputs[k + j].write(parity[j].tobytes())
+                consume(got)
         except BaseException as e:
             errors.append(e)
-            while write_q.get() is not None:  # drain so the producer can't block
+            while write_q.get() is not None:  # drain so the feeder unblocks
                 pass
 
     rt = threading.Thread(target=reader, daemon=True)
@@ -177,16 +167,12 @@ def _encode_pipelined(dat, items, codec, outputs, dat_size: int) -> None:
             got = read_q.get()
             if got is None:
                 break
-            it, data = got
-            width = it[3]
-            piece = data
-            if width % align:
-                padded = align * -(-width // align)
-                piece = np.pad(data, ((0, 0), (0, padded - width)))
-            parity_dev = codec.matmul_device(
-                codec.parity_rows, codec.device_put(piece)
-            )
-            write_q.put((it, data, parity_dev))
+            if errors:
+                continue  # keep draining so the reader can finish
+            try:
+                write_q.put(compute(got))
+            except BaseException as e:
+                errors.append(e)
     finally:
         write_q.put(None)
         wt.join()
@@ -199,6 +185,44 @@ def _encode_pipelined(dat, items, codec, outputs, dat_size: int) -> None:
         rt.join()
     if errors:
         raise errors[0]
+
+
+def _encode_pipelined(dat, items, codec, outputs, dat_size: int) -> None:
+    k, m = codec.data_shards, codec.parity_shards
+    align = codec.alignment() if hasattr(codec, "alignment") else 1
+
+    def produce():
+        with open(dat, "rb") as f:
+            for it in items:
+                start, block_size, col, width = it
+                yield (
+                    it,
+                    _read_block_columns(
+                        f, start, block_size, col, width, k, dat_size
+                    ),
+                )
+
+    def compute(got):
+        it, data = got
+        width = it[3]
+        piece = data
+        if width % align:
+            padded = align * -(-width // align)
+            piece = np.pad(data, ((0, 0), (0, padded - width)))
+        parity_dev = codec.matmul_device(
+            codec.parity_rows, codec.device_put(piece)
+        )
+        return it, data, parity_dev
+
+    def consume(got):
+        (_, _, _, width), data, parity_dev = got
+        parity = np.asarray(parity_dev)[:, :width]  # blocks until ready
+        for i in range(k):
+            outputs[i].write(data[i, :width].tobytes())
+        for j in range(m):
+            outputs[k + j].write(parity[j].tobytes())
+
+    _overlap_pipeline(produce, compute, consume)
 
 
 def rebuild_ec_files(
@@ -235,23 +259,91 @@ def rebuild_ec_files(
     ins = {sid: open(p, "rb") for sid, p in present.items()}
     outs = {sid: open(base_file_name + shard_ext(sid), "wb") for sid in missing}
     try:
-        pos = 0
-        while pos < shard_size:
-            width = min(chunk, shard_size - pos)
-            shards: list[Optional[np.ndarray]] = [None] * total
-            for sid, fh in ins.items():
-                fh.seek(pos)
-                shards[sid] = np.frombuffer(fh.read(width), dtype=np.uint8)
-            rebuilt = codec.reconstruct(shards)
-            for sid in missing:
-                outs[sid].write(rebuilt[sid].tobytes())
-            pos += width
+        if hasattr(codec, "matmul_device"):
+            _rebuild_pipelined(
+                codec, ins, outs, missing, shard_size, chunk
+            )
+        else:
+            pos = 0
+            while pos < shard_size:
+                width = min(chunk, shard_size - pos)
+                shards: list[Optional[np.ndarray]] = [None] * total
+                for sid, fh in ins.items():
+                    fh.seek(pos)
+                    shards[sid] = np.frombuffer(
+                        fh.read(width), dtype=np.uint8
+                    )
+                rebuilt = codec.reconstruct(shards)
+                for sid in missing:
+                    outs[sid].write(rebuilt[sid].tobytes())
+                pos += width
     finally:
         for fh in ins.values():
             fh.close()
         for fh in outs.values():
             fh.close()
     return missing
+
+
+def _rebuild_rows(codec, present_ids: list[int], missing: list[int]) -> np.ndarray:
+    """One matrix rebuilding every missing shard from the first k present
+    shards. Missing data shards take their decode-matrix rows; missing
+    parity rows compose through the full decode matrix
+    (matrix[mp] · decode = parity-of-reconstructed-data), so a single
+    matmul per chunk covers both — bit-identical to the two-step
+    Codec.reconstruct, which tests assert."""
+    from . import gf
+
+    k = codec.data_shards
+    first_k = present_ids[:k]
+    decode_full = codec._decode_matrix_for(first_k)
+    missing_data = [i for i in missing if i < k]
+    missing_parity = [i for i in missing if i >= k]
+    blocks = []
+    if missing_data:
+        blocks.append(decode_full[missing_data])
+    if missing_parity:
+        blocks.append(gf.mat_mul(codec.matrix[missing_parity], decode_full))
+    # missing is sorted and data ids < parity ids, so this stacking order
+    # matches the outs iteration order
+    return np.vstack(blocks)
+
+
+def _rebuild_pipelined(codec, ins, outs, missing, shard_size, chunk) -> None:
+    """Overlap disk reads, H2D staging + device matmul, and shard writes —
+    the encode pipeline's shape applied to rebuild (the serial
+    read→reconstruct→write loop leaves the device idle during IO)."""
+    k = codec.data_shards
+    present_ids = sorted(ins)
+    first_k = present_ids[:k]
+    rows = _rebuild_rows(codec, present_ids, missing)
+    align = codec.alignment() if hasattr(codec, "alignment") else 1
+
+    def produce():
+        pos = 0
+        while pos < shard_size:
+            width = min(chunk, shard_size - pos)
+            padded = -(-width // align) * align  # zeros encode to zeros
+            buf = np.zeros((k, padded), dtype=np.uint8)
+            for row, sid in enumerate(first_k):
+                ins[sid].seek(pos)
+                buf[row, :width] = np.frombuffer(
+                    ins[sid].read(width), dtype=np.uint8
+                )
+            yield (width, buf)
+            pos += width
+
+    def compute(got):
+        width, buf = got
+        return width, codec.matmul_device(rows, codec.device_put(buf))
+
+    def consume(got):
+        width, out_dev = got
+        out = np.asarray(out_dev)[:, :width]  # blocks until ready
+        for j, sid in enumerate(missing):
+            outs[sid].write(out[j].tobytes())
+
+    _overlap_pipeline(produce, compute, consume)
 
 
 # -- .ecx sorted index -------------------------------------------------------

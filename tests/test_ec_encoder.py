@@ -91,6 +91,72 @@ def test_rebuild_worst_case_bit_identical(fixture_volume):
             assert f.read() == want, f"shard {sid} not bit-identical after rebuild"
 
 
+@pytest.mark.parametrize(
+    "gone",
+    [
+        (0, 1, 2, 3),          # 4 data shards: worst case
+        (10, 11, 12, 13),      # parity only (composed decode rows)
+        (7,),                  # single data shard
+        (2, 11),               # mixed
+    ],
+)
+def test_rebuild_pipelined_combos_bit_identical(fixture_volume, gone):
+    """The overlap pipeline's single combined matmul must equal the
+    two-step serial reconstruct for every missing-shard shape."""
+    from seaweedfs_tpu.ec.codec import TpuCodec
+
+    base, _ = fixture_volume
+    codec = TpuCodec(chunk_bytes=8 * 1024, tile_bytes=1024)
+    encoder.write_ec_files(base, codec, LARGE, SMALL, chunk_bytes=4096)
+    orig = {}
+    for sid in gone:
+        with open(base + shard_ext(sid), "rb") as f:
+            orig[sid] = f.read()
+        os.remove(base + shard_ext(sid))
+    assert hasattr(codec, "matmul_device")  # pipelined path engaged
+    generated = encoder.rebuild_ec_files(base, codec, chunk_bytes=3000)
+    assert sorted(generated) == sorted(gone)
+    for sid, want in orig.items():
+        with open(base + shard_ext(sid), "rb") as f:
+            assert f.read() == want, f"shard {sid} differs"
+
+
+def test_rebuild_pipeline_error_raises_not_hangs(fixture_volume):
+    """A device failure mid-pipeline must surface as an exception promptly,
+    not deadlock the reader on a full queue (regression: the shutdown path
+    must drain both queues)."""
+    import threading
+
+    from seaweedfs_tpu.ec.codec import TpuCodec
+
+    base, _ = fixture_volume
+    codec = TpuCodec(chunk_bytes=8 * 1024, tile_bytes=1024)
+    encoder.write_ec_files(base, codec, LARGE, SMALL, chunk_bytes=4096)
+    os.remove(base + shard_ext(2))
+
+    class _Exploding(TpuCodec):
+        def device_put(self, data):
+            raise RuntimeError("injected device failure")
+
+    bad = _Exploding(chunk_bytes=8 * 1024, tile_bytes=1024)
+    result: list = []
+
+    def run():
+        try:
+            # tiny chunks → many queue items → a blocked reader if the
+            # shutdown path doesn't drain
+            encoder.rebuild_ec_files(base, bad, chunk_bytes=512)
+            result.append("no error")
+        except RuntimeError as e:
+            result.append(str(e))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=20)
+    assert not t.is_alive(), "rebuild deadlocked on device failure"
+    assert result == ["injected device failure"]
+
+
 def test_rebuild_noop_when_all_present(fixture_volume):
     base, _ = fixture_volume
     codec = CpuCodec()
