@@ -206,7 +206,9 @@ class Needle:
         if idx < n:
             data_size = struct.unpack(">I", b[idx : idx + 4])[0]
             idx += 4
-            if data_size + idx > n:
+            if data_size + idx >= n:
+                # the flags byte always follows the data — a data_size that
+                # leaves no room for it is a corrupt length prefix
                 raise ValueError("needle body truncated: data")
             self.data = bytes(b[idx : idx + data_size])
             idx += data_size
