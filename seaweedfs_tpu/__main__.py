@@ -207,58 +207,207 @@ def cmd_ec_encode(args):
     print(r)
 
 
+class _BenchPump:
+    """Single-threaded event-loop HTTP/1.1 load generator.
+
+    The reference's benchmark client is compiled Go with goroutine workers
+    (weed/command/benchmark.go:196); 16 Python threads spend more time in
+    GIL handoffs than in requests.  One selectors loop with `concurrency`
+    keep-alive sockets (one in-flight request each, so per-request latency
+    stays honest) drives the turbo data plane at event-loop cost."""
+
+    def __init__(self, concurrency: int):
+        import selectors
+
+        self.sel = selectors.DefaultSelector()
+        self.concurrency = concurrency
+        self.latencies: list[float] = []
+        self.failures = 0
+
+    def _connect(self, addr):
+        import socket
+
+        host, port = addr.split(":")
+        s = socket.create_connection((host, int(port)))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # blocking: sendall must complete even past the kernel buffer;
+        # recv only runs after select says readable, so it never blocks long
+        return s
+
+    def run(self, jobs) -> float:
+        """jobs: iterator of (addr, request_bytes). Returns wall seconds."""
+        import socket
+
+        slots = []  # [addr, sock, buf, t0, need, busy]
+        for _ in range(self.concurrency):
+            slots.append({"addr": None, "sock": None, "buf": b"", "t0": 0.0,
+                          "busy": False})
+        it = iter(jobs)
+        pending = True
+        inflight = 0
+        t_start = time.perf_counter()
+
+        def feed(slot):
+            nonlocal pending, inflight
+            if not pending:
+                return False
+            try:
+                addr, req = next(it)
+            except StopIteration:
+                pending = False
+                return False
+            if slot["addr"] != addr or slot["sock"] is None:
+                if slot["sock"] is not None:
+                    self.sel.unregister(slot["sock"])
+                    slot["sock"].close()
+                slot["sock"] = self._connect(addr)
+                slot["addr"] = addr
+                import selectors
+
+                self.sel.register(slot["sock"], selectors.EVENT_READ, slot)
+            slot["buf"] = b""
+            slot["t0"] = time.perf_counter()
+            slot["busy"] = True
+            slot["req"] = req
+            try:
+                slot["sock"].sendall(req)
+            except OSError:
+                slot["busy"] = False
+                self.failures += 1
+                self.sel.unregister(slot["sock"])
+                slot["sock"].close()
+                slot["sock"] = None
+                return True  # job consumed (counted failed); slot reusable
+            inflight += 1
+            return True
+
+        def finish(slot, ok):
+            nonlocal inflight
+            inflight -= 1
+            slot["busy"] = False
+            if ok:
+                self.latencies.append(time.perf_counter() - slot["t0"])
+            else:
+                self.failures += 1
+                # drop the (possibly poisoned) connection
+                self.sel.unregister(slot["sock"])
+                slot["sock"].close()
+                slot["sock"] = None
+
+        for slot in slots:
+            if not feed(slot):
+                break
+        while inflight > 0:
+            for key, _ in self.sel.select(timeout=5.0):
+                slot = key.data
+                if not slot["busy"]:
+                    continue
+                try:
+                    chunk = slot["sock"].recv(262144)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    finish(slot, False)
+                    feed(slot)
+                    continue
+                if not chunk:
+                    finish(slot, False)
+                    feed(slot)
+                    continue
+                slot["buf"] += chunk
+                he = slot["buf"].find(b"\r\n\r\n")
+                if he < 0:
+                    continue
+                head = slot["buf"][:he].lower()
+                cl = 0
+                ix = head.find(b"content-length:")
+                if ix >= 0:
+                    end = head.find(b"\r\n", ix)
+                    if end < 0:
+                        end = len(head)
+                    cl = int(head[ix + 15:end].strip())
+                if len(slot["buf"]) < he + 4 + cl:
+                    continue
+                status = int(slot["buf"][9:12])
+                finish(slot, 200 <= status < 300)
+                feed(slot)
+        return time.perf_counter() - t_start
+
+
 def cmd_benchmark(args):
     """The reference's benchmark (command/benchmark.go; defaults: 1KB files,
-    c=16, n=1048576 — scaled down by default here; use -n to match)."""
-    import concurrent.futures
+    c=16, n=1048576 — scaled down by default here; use -n to match).
+
+    File ids come from count-batched assigns (`/dir/assign?count=N` + the
+    `fid_<delta>` sub-fid form, both first-class in the reference:
+    master_server_handlers.go:96, needle.go:120-142); -assign.batch 1
+    restores one-assign-per-file."""
     import secrets
 
     from . import operation
 
     payload = secrets.token_bytes(args.size)
-    fids: list[str] = []
-    latencies: list[float] = []
+    batch = max(1, args.assign_batch)
+    print(f"writing {args.n} files of {args.size}B with concurrency {args.c} "
+          f"(assign batch {batch}) ...")
 
-    def one_write(i):
-        t0 = time.perf_counter()
-        fid = operation.submit(args.master, payload, collection=args.collection)
-        return fid, time.perf_counter() - t0
+    fids: list[tuple[str, str]] = []  # (fid, volume server addr)
 
-    print(f"writing {args.n} files of {args.size}B with concurrency {args.c} ...")
-    t0 = time.perf_counter()
-    with concurrent.futures.ThreadPoolExecutor(args.c) as pool:
-        for fid, dt in pool.map(one_write, range(args.n)):
-            fids.append(fid)
-            latencies.append(dt)
-    wall = time.perf_counter() - t0
-    _report("write", args, latencies, wall)
+    def write_jobs():
+        remaining = args.n
+        while remaining > 0:
+            a = operation.assign(args.master, count=min(batch, remaining),
+                                 collection=args.collection)
+            got = max(1, a.count)
+            for i in range(min(got, remaining)):
+                fid = a.fid if i == 0 else f"{a.fid}_{i}"
+                fids.append((fid, a.url))
+                req = (f"POST /{fid} HTTP/1.1\r\nHost: {a.url}\r\n"
+                       f"Content-Length: {len(payload)}\r\n\r\n").encode() + payload
+                yield a.url, req
+            remaining -= min(got, remaining)
 
-    def one_read(fid):
-        t0 = time.perf_counter()
-        data = operation.download(args.master, fid)
-        assert len(data) == args.size
-        return time.perf_counter() - t0
+    pump = _BenchPump(args.c)
+    wall = pump.run(write_jobs())
+    _report("write", args, pump.latencies, wall, pump.failures)
 
-    latencies = []
     print(f"reading {len(fids)} files ...")
-    t0 = time.perf_counter()
-    with concurrent.futures.ThreadPoolExecutor(args.c) as pool:
-        latencies = list(pool.map(one_read, fids))
-    wall = time.perf_counter() - t0
-    _report("read", args, latencies, wall)
+    lookup_cache: dict[int, str] = {}
+
+    def read_jobs():
+        import random
+
+        random.shuffle(fids)
+        for fid, url in fids:
+            vid = int(fid.split(",")[0])
+            addr = lookup_cache.get(vid)
+            if addr is None:
+                locs = operation.lookup(args.master, vid)
+                addr = locs[0]["url"] if locs else url
+                lookup_cache[vid] = addr
+            req = f"GET /{fid} HTTP/1.1\r\nHost: {addr}\r\n\r\n".encode()
+            yield addr, req
+
+    pump = _BenchPump(args.c)
+    wall = pump.run(read_jobs())
+    _report("read", args, pump.latencies, wall, pump.failures)
 
 
-def _report(op, args, latencies, wall):
+def _report(op, args, latencies, wall, failures=0):
     import numpy as np
 
     lat = np.array(sorted(latencies))
     total = len(lat)
     print(f"\n--- {op} ---")
+    if total == 0:
+        print(f"failed: {failures} / {failures} (no successful requests)")
+        return
     print(f"requests/sec: {total / wall:,.2f}")
     print(f"transfer/sec: {total * args.size / wall / 1e6:,.2f} MB/s")
     for p in (50, 90, 99):
         print(f"p{p} latency: {lat[int(total * p / 100) - 1] * 1000:.2f} ms")
     print(f"max latency: {lat[-1] * 1000:.2f} ms")
+    print(f"failed: {failures} / {total + failures}")
 
 
 def cmd_backup(args):
@@ -713,6 +862,8 @@ def main(argv=None):
     b.add_argument("-n", type=int, default=10000)
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-collection", default="benchmark")
+    b.add_argument("-assign.batch", dest="assign_batch", type=int, default=100,
+                   help="fids reserved per /dir/assign call (1 = per-file)")
     b.set_defaults(fn=cmd_benchmark)
 
     bk = sub.add_parser("backup", help="incremental local volume backup")

@@ -95,6 +95,7 @@ class VolumeServer:
         )
         self.store.remote_shard_reader = self._remote_shard_reader
         self._srv = None
+        self.turbo = None
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
 
@@ -131,7 +132,9 @@ class VolumeServer:
             raise ValueError(f"bad fid path {path!r}")
         if "." in fid:
             fid = fid[: fid.rindex(".")]
-        nid, cookie = parse_needle_id_cookie(fid)
+        from ..storage.file_id import parse_path
+
+        nid, cookie = parse_path(fid)  # supports the _<delta> batch suffix
         return int(vid_str), nid, cookie
 
     def _auth_ok(self, h, path, q, key: str) -> bool:
@@ -1110,7 +1113,45 @@ class VolumeServer:
                 ("DELETE", "/", vs._h_delete),
             ]
 
-        self._srv = start_server(Handler, self.host, self.port)
+        # Native turbo data plane: the C++ engine owns the public port and
+        # serves fid GET/POST/DELETE directly; this Python daemon moves to
+        # an internal loopback port and receives proxied admin/exotic
+        # requests.  Falls back to the classic single-server layout when
+        # the native library is unavailable or auth features need the
+        # Python request pipeline.
+        self.turbo = None
+        use_turbo = (
+            os.environ.get("SWEED_TURBO", "1") != "0"
+            and not self.jwt_signing_key
+            and not self.jwt_read_key
+            and self.guard.allow_all
+        )
+        if use_turbo:
+            internal = None
+            try:
+                from ..native.turbo import TurboEngine, turbo_available
+
+                if turbo_available():
+                    internal = start_server(Handler, "127.0.0.1", 0)
+                    iport = internal.server_address[1]
+                    self.turbo = TurboEngine(
+                        self.host, self.port, "127.0.0.1", iport
+                    )
+                    self._srv = internal
+                    self.store.turbo_engine = self.turbo
+                    self.store.attach_turbo_all()
+                    glog.info(
+                        "turbo data plane on %s:%d (%d workers) → python %d",
+                        self.host, self.port, self.turbo.threads, iport,
+                    )
+            except Exception as e:  # noqa: BLE001
+                glog.warning("turbo engine disabled: %s", e)
+                self.turbo = None
+                if internal is not None:  # don't leak the loopback server
+                    internal.shutdown()
+                    internal.server_close()
+        if self.turbo is None:
+            self._srv = start_server(Handler, self.host, self.port)
         glog.info("volume server up on %s:%d (%d volumes) → master %s",
                   self.host, self.port,
                   sum(len(l.volumes) for l in self.store.locations),
@@ -1126,8 +1167,17 @@ class VolumeServer:
     def stop(self):
         self._stop.set()
         self.store.delta_event.set()  # wake the heartbeat loop to exit
+        # stop accepting on the PUBLIC port first (the native engine drains
+        # in-flight proxies against the still-live backend), then the
+        # loopback backend, then the store (volume detach is a no-op C call
+        # against the already-freed engine handle, guarded native-side)
+        if self.turbo is not None:
+            self.turbo.stop()
         if self._srv:
             self._srv.shutdown()
             self._srv.server_close()
         self.store.close()
+        if self.turbo is not None:
+            self.turbo = None
+            self.store.turbo_engine = None
         glog.info("volume server %s:%d stopped", self.host, self.port)

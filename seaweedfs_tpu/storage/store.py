@@ -55,6 +55,9 @@ class Store:
         self._ec_codec: Optional[Codec] = None
         self._ec_backend = ec_backend
         self.remote_shard_reader: Optional[RemoteShardReader] = None
+        # native turbo data plane (native/turbo.py); set by the volume
+        # server when it owns the public port through the engine
+        self.turbo_engine = None
         # delta queues consumed by the heartbeat loop (store.go:33-50 —
         # NewVolumesChan etc.); entries are heartbeat message dicts so the
         # master can apply them without a full sync. delta_event wakes the
@@ -93,8 +96,23 @@ class Store:
         v = Volume(loc.directory, collection, vid, replica_placement, ttl,
                    needle_map_kind=self.needle_map_kind)
         loc.add_volume(v)
+        self.attach_turbo_volume(v)
         self.queue_new_volume(v)
         return v
+
+    def attach_turbo_volume(self, v: Volume) -> None:
+        """Hand a volume's data plane to the native engine (if one is up).
+        Replicated volumes keep HTTP writes in Python (fan-out logic) but
+        still delegate index/append ownership for reads."""
+        if self.turbo_engine is None:
+            return
+        writable_http = v.super_block.replica_placement.copy_count() == 1
+        v.attach_turbo(self.turbo_engine, writable_http)
+
+    def attach_turbo_all(self) -> None:
+        for loc in self.locations:
+            for v in list(loc.volumes.values()):
+                self.attach_turbo_volume(v)
 
     def _pick_location(self) -> DiskLocation:
         return min(self.locations, key=lambda l: l.volume_count())
@@ -165,6 +183,7 @@ class Store:
                     needle_map_kind=loc.needle_map_kind,
                 )
                 loc.add_volume(v)
+                self.attach_turbo_volume(v)
                 self.queue_new_volume(v)
                 return v
         return None

@@ -80,12 +80,16 @@ class Volume:
         self.collection = collection
         self.id = vid
         self.offset_size = offset_size
+        # native turbo engine (native/turbo.py); while attached, the engine
+        # is the single writer of .dat/.idx and owns the needle map
+        self.turbo = None
+        self._turbo_writable_http = True
         # needle map kind (needle_map.go:12-19): "dense" = 16B/entry packed
         # arrays (the reference's in-memory CompactMap profile), "memory" =
         # plain dict, "sqlite" = on-disk B-tree for RAM-exceeding volumes
         # (the leveldb kind)
         self.needle_map_kind = needle_map_kind
-        self.read_only = False
+        self._read_only = False
         self.last_append_at_ns = 0
         self.last_modified_ts_seconds = 0
         self._lock = threading.RLock()
@@ -176,6 +180,82 @@ class Volume:
             self.read_only = True
             return SortedFileNeedleMap(sdx, self.offset_size, idx_file)
         raise ValueError(f"unknown needle map kind {kind!r}")
+
+    # -- native turbo attach/detach ------------------------------------------
+    @property
+    def read_only(self) -> bool:
+        return self._read_only
+
+    @read_only.setter
+    def read_only(self, value: bool) -> None:
+        self._read_only = value
+        if self.turbo is not None:
+            self.turbo.set_readonly(self.id, value)
+
+    def attach_turbo(self, engine, writable_http: bool = True) -> bool:
+        """Hand the data plane to the native engine.  Refused for volume
+        kinds the engine can't own safely (sorted/sealed maps, remote-tier
+        backends, volume-level TTL inheritance)."""
+        if self.turbo is not None:
+            return True
+        if self.needle_map_kind == "sorted":
+            return False
+        if not isinstance(self.data_backend, DiskFile):
+            return False  # remote tier: reads go through S3
+        if self.ttl != EMPTY_TTL:
+            return False  # native writer doesn't inherit volume TTLs
+        from ..native.turbo import TurboNeedleMap
+
+        base = self.file_name()
+        with self._lock:
+            self.sync()
+            if not engine.register(
+                self.id, base + ".dat", base + ".idx", self.version,
+                self.offset_size, writable_http, self._read_only,
+            ):
+                return False
+            idx_file = self.nm._index_file
+            self.nm.release()
+            self.nm = TurboNeedleMap(engine, self.id, idx_file,
+                                     self.offset_size)
+            self.turbo = engine
+            self._turbo_writable_http = writable_http
+        return True
+
+    def detach_turbo(self, reload_map: bool = True) -> None:
+        """Take the data plane back; reload the Python needle map from the
+        .idx the engine kept current."""
+        if self.turbo is None:
+            return
+        with self._lock:
+            engine = self.turbo
+            self.turbo = None
+            engine.unregister(self.id)
+            idx_file = self.nm._index_file
+            if reload_map:
+                self.nm = self._load_needle_map(idx_file)
+            else:
+                self.nm = CompactNeedleMap(idx_file, self.offset_size)
+
+    def _turbo_reattach_ctx(self):
+        """Context manager: detach for a file-rewriting operation, re-attach
+        after (used by compact)."""
+        import contextlib
+
+        vol = self
+
+        @contextlib.contextmanager
+        def ctx():
+            engine = vol.turbo
+            writable = vol._turbo_writable_http
+            vol.detach_turbo()
+            try:
+                yield
+            finally:
+                if engine is not None:
+                    vol.attach_turbo(engine, writable)
+
+        return ctx()
 
     # -- identity ------------------------------------------------------------
     def file_name(self) -> str:
@@ -345,10 +425,15 @@ class Volume:
                     raise VolumeError(f"reading existing needle: {e}")
             n.append_at_ns = append_at_ns or time.time_ns()
             blob = n.to_bytes(self.version)
-            offset = self.data_backend.append(blob)
+            if self.turbo is not None:
+                # the native engine owns the append (dat + idx + map updated
+                # atomically under its per-volume lock)
+                offset = self.turbo.append(self.id, n.id, blob, n.size, False)
+            else:
+                offset = self.data_backend.append(blob)
+                if nv is None or nv.offset < offset:
+                    self.nm.put(n.id, offset, n.size)
             self.last_append_at_ns = n.append_at_ns
-            if nv is None or nv.offset < offset:
-                self.nm.put(n.id, offset, n.size)
             if self.last_modified_ts_seconds < n.last_modified:
                 self.last_modified_ts_seconds = n.last_modified
             if fsync:
@@ -387,9 +472,12 @@ class Volume:
             n.data = b""
             n.append_at_ns = append_at_ns or time.time_ns()
             blob = n.to_bytes(self.version)
-            offset = self.data_backend.append(blob)
+            if self.turbo is not None:
+                self.turbo.append(self.id, n.id, blob, 0, True)
+            else:
+                offset = self.data_backend.append(blob)
+                self.nm.delete(n.id, offset)
             self.last_append_at_ns = n.append_at_ns
-            self.nm.delete(n.id, offset)
             return size
 
     # -- read path (volume_read_write.go:262-302) ----------------------------
@@ -511,6 +599,7 @@ class Volume:
             secret_key = bc["secret_key"]
         if not endpoint:
             raise VolumeError("tier_upload needs -backend or an endpoint")
+        self.detach_turbo()  # sealing moves the .dat off local disk
         with self._lock:
             was_read_only = self.read_only
             self.read_only = True
@@ -630,6 +719,12 @@ class Volume:
         from . import idx as idx_mod
         from ..util.throttler import WriteThrottler
         from .types import needle_map_entry_size
+
+        if self.turbo is not None:
+            # compaction rewrites the .dat/.idx pair: take the data plane
+            # back for the duration, re-attach over the compacted files
+            with self._turbo_reattach_ctx():
+                return self.compact(bytes_per_second)
 
         throttler = WriteThrottler(bytes_per_second)
 
@@ -772,10 +867,14 @@ class Volume:
 
     # -- lifecycle -----------------------------------------------------------
     def sync(self) -> None:
+        if self.turbo is not None:
+            self.turbo.sync(self.id)
+            return
         self.data_backend.sync()
         self.nm.sync()
 
     def close(self) -> None:
+        self.detach_turbo(reload_map=False)
         with self._lock:
             self.nm.close()
             self.data_backend.close()
