@@ -37,6 +37,21 @@ def _security_conf():
     }
 
 
+
+def _maybe_start_pusher(args, job: str, instance: str):
+    """-metrics.address → push-gateway loop (stats/metrics.go:69); the
+    /metrics pull endpoint works regardless."""
+    addr = getattr(args, "metrics_address", "")
+    if not addr:
+        return None
+    from .stats import MetricsPusher, default_registry
+
+    return MetricsPusher(
+        default_registry, addr, job, instance,
+        interval_seconds=getattr(args, "metrics_interval", 15.0),
+    ).start()
+
+
 def cmd_master(args):
     from .server.master_server import MasterServer
 
@@ -52,6 +67,7 @@ def cmd_master(args):
         jwt_signing_key=sec["jwt_signing_key"],
         jwt_expires_seconds=sec["jwt_expires"],
     ).start()
+    _maybe_start_pusher(args, "master", ms.url)
     print(f"master listening on {ms.url}")
     _wait_forever()
 
@@ -76,6 +92,7 @@ def cmd_volume(args):
         jwt_read_key=sec["jwt_read_key"],
         whitelist=sec["whitelist"] or None,
     ).start()
+    _maybe_start_pusher(args, "volumeServer", f"{vs.host}:{vs.port}")
     print(f"volume server on {vs.host}:{vs.port} → master {args.mserver}")
     _wait_forever()
 
@@ -248,38 +265,46 @@ class _BenchPump:
         t_start = time.perf_counter()
 
         def feed(slot):
+            # loop so a send failure consumes the next job on a fresh
+            # connection instead of permanently parking this slot
             nonlocal pending, inflight
-            if not pending:
-                return False
-            try:
-                addr, req = next(it)
-            except StopIteration:
-                pending = False
-                return False
-            if slot["addr"] != addr or slot["sock"] is None:
-                if slot["sock"] is not None:
-                    self.sel.unregister(slot["sock"])
-                    slot["sock"].close()
-                slot["sock"] = self._connect(addr)
-                slot["addr"] = addr
-                import selectors
+            while True:
+                if not pending:
+                    return False
+                try:
+                    addr, req = next(it)
+                except StopIteration:
+                    pending = False
+                    return False
+                try:
+                    if slot["addr"] != addr or slot["sock"] is None:
+                        if slot["sock"] is not None:
+                            self.sel.unregister(slot["sock"])
+                            slot["sock"].close()
+                            slot["sock"] = None
+                        slot["sock"] = self._connect(addr)
+                        slot["addr"] = addr
+                        import selectors
 
-                self.sel.register(slot["sock"], selectors.EVENT_READ, slot)
-            slot["buf"] = b""
-            slot["t0"] = time.perf_counter()
-            slot["busy"] = True
-            slot["req"] = req
-            try:
-                slot["sock"].sendall(req)
-            except OSError:
-                slot["busy"] = False
-                self.failures += 1
-                self.sel.unregister(slot["sock"])
-                slot["sock"].close()
-                slot["sock"] = None
-                return True  # job consumed (counted failed); slot reusable
-            inflight += 1
-            return True
+                        self.sel.register(slot["sock"], selectors.EVENT_READ,
+                                          slot)
+                    slot["buf"] = b""
+                    slot["t0"] = time.perf_counter()
+                    slot["req"] = req
+                    slot["sock"].sendall(req)
+                except OSError:
+                    self.failures += 1
+                    if slot["sock"] is not None:
+                        try:
+                            self.sel.unregister(slot["sock"])
+                        except KeyError:
+                            pass
+                        slot["sock"].close()
+                        slot["sock"] = None
+                    continue  # job counted failed; try the next one
+                slot["busy"] = True
+                inflight += 1
+                return True
 
         def finish(slot, ok):
             nonlocal inflight
@@ -334,30 +359,24 @@ class _BenchPump:
         return time.perf_counter() - t_start
 
 
-def cmd_benchmark(args):
-    """The reference's benchmark (command/benchmark.go; defaults: 1KB files,
-    c=16, n=1048576 — scaled down by default here; use -n to match).
-
-    File ids come from count-batched assigns (`/dir/assign?count=N` + the
-    `fid_<delta>` sub-fid form, both first-class in the reference:
-    master_server_handlers.go:96, needle.go:120-142); -assign.batch 1
-    restores one-assign-per-file."""
+def run_benchmark(master: str, n: int, c: int, size: int,
+                  collection: str = "benchmark",
+                  assign_batch: int = 100) -> dict:
+    """Write-then-read load run; returns the raw stats for both phases.
+    Shared by `weed benchmark` (below) and bench.py's small-file probe."""
     import secrets
 
     from . import operation
 
-    payload = secrets.token_bytes(args.size)
-    batch = max(1, args.assign_batch)
-    print(f"writing {args.n} files of {args.size}B with concurrency {args.c} "
-          f"(assign batch {batch}) ...")
-
+    payload = secrets.token_bytes(size)
+    batch = max(1, assign_batch)
     fids: list[tuple[str, str]] = []  # (fid, volume server addr)
 
     def write_jobs():
-        remaining = args.n
+        remaining = n
         while remaining > 0:
-            a = operation.assign(args.master, count=min(batch, remaining),
-                                 collection=args.collection)
+            a = operation.assign(master, count=min(batch, remaining),
+                                 collection=collection)
             got = max(1, a.count)
             for i in range(min(got, remaining)):
                 fid = a.fid if i == 0 else f"{a.fid}_{i}"
@@ -367,11 +386,9 @@ def cmd_benchmark(args):
                 yield a.url, req
             remaining -= min(got, remaining)
 
-    pump = _BenchPump(args.c)
-    wall = pump.run(write_jobs())
-    _report("write", args, pump.latencies, wall, pump.failures)
+    wpump = _BenchPump(c)
+    wwall = wpump.run(write_jobs())
 
-    print(f"reading {len(fids)} files ...")
     lookup_cache: dict[int, str] = {}
 
     def read_jobs():
@@ -382,15 +399,40 @@ def cmd_benchmark(args):
             vid = int(fid.split(",")[0])
             addr = lookup_cache.get(vid)
             if addr is None:
-                locs = operation.lookup(args.master, vid)
+                locs = operation.lookup(master, vid)
                 addr = locs[0]["url"] if locs else url
                 lookup_cache[vid] = addr
             req = f"GET /{fid} HTTP/1.1\r\nHost: {addr}\r\n\r\n".encode()
             yield addr, req
 
-    pump = _BenchPump(args.c)
-    wall = pump.run(read_jobs())
-    _report("read", args, pump.latencies, wall, pump.failures)
+    rpump = _BenchPump(c)
+    rwall = rpump.run(read_jobs())
+    return {
+        "write": {"wall": wwall, "latencies": wpump.latencies,
+                  "failures": wpump.failures},
+        "read": {"wall": rwall, "latencies": rpump.latencies,
+                 "failures": rpump.failures},
+    }
+
+
+def cmd_benchmark(args):
+    """The reference's benchmark (command/benchmark.go; defaults: 1KB files,
+    c=16, n=1048576 — scaled down by default here; use -n to match).
+
+    File ids come from count-batched assigns (`/dir/assign?count=N` + the
+    `fid_<delta>` sub-fid form, both first-class in the reference:
+    master_server_handlers.go:96, needle.go:120-142); -assign.batch 1
+    restores one-assign-per-file."""
+    batch = max(1, args.assign_batch)
+    print(f"writing {args.n} files of {args.size}B with concurrency {args.c} "
+          f"(assign batch {batch}) ...")
+    stats = run_benchmark(args.master, args.n, args.c, args.size,
+                          args.collection, batch)
+    _report("write", args, stats["write"]["latencies"], stats["write"]["wall"],
+            stats["write"]["failures"])
+    print(f"reading {args.n} files ...")
+    _report("read", args, stats["read"]["latencies"], stats["read"]["wall"],
+            stats["read"]["failures"])
 
 
 def _report(op, args, latencies, wall, failures=0):
@@ -568,7 +610,29 @@ def cmd_filer_replicate(args):
 
 
 def cmd_mount(args):
-    """Continuous local-dir ⇄ filer sync (weed mount, FUSE-less)."""
+    """weed mount: kernel-visible FUSE filesystem over the filer when
+    libfuse + /dev/fuse are present (filesys/wfs.go), falling back to the
+    FUSE-less local-dir ⇄ filer sync daemon."""
+    use_fuse = args.mode != "sync"
+    if use_fuse:
+        from .mount.fuse_mount import FuseMount, fuse_available
+
+        if fuse_available():
+            from .mount.wfs import WFS
+
+            wfs = WFS(args.filer, collection=args.collection)
+            fm = FuseMount(wfs, args.dir, root=args.filer_path).mount()
+            print(f"FUSE-mounted {args.filer}{args.filer_path} at {args.dir}")
+            try:
+                _wait_forever()
+            finally:
+                fm.unmount()
+                wfs.close()
+            return
+        if args.mode == "fuse":
+            print("fuse unavailable (no libfuse or /dev/fuse)", file=sys.stderr)
+            sys.exit(1)
+        print("fuse unavailable; falling back to sync mode", file=sys.stderr)
     from .mount.sync import MountSync
 
     ms = MountSync(
@@ -768,6 +832,10 @@ def main(argv=None):
         default="",
         help="comma-separated master peers for HA (weed master -peers)",
     )
+    m.add_argument("-metrics.address", dest="metrics_address", default="",
+                   help="Prometheus push gateway host:port (push loop)")
+    m.add_argument("-metrics.intervalSeconds", dest="metrics_interval",
+                   type=float, default=15.0)
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="run a volume server")
@@ -792,6 +860,10 @@ def main(argv=None):
                    choices=["memory", "dense", "sqlite", "sorted"],
                    help="needle map kind (weed volume -index memory|leveldb)")
     v.add_argument("-ec.backend", dest="ec_backend", default="", choices=["", "tpu", "cpu", "numpy", "mesh"])
+    v.add_argument("-metrics.address", dest="metrics_address", default="",
+                   help="Prometheus push gateway host:port (push loop)")
+    v.add_argument("-metrics.intervalSeconds", dest="metrics_interval",
+                   type=float, default=15.0)
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server", help="master + volume in one process")
@@ -926,10 +998,14 @@ def main(argv=None):
     frep.add_argument("-s3.secretKey", dest="s3_secret_key", default="")
     frep.set_defaults(fn=cmd_filer_replicate)
 
-    mnt = sub.add_parser("mount", help="sync a local dir with a filer dir")
+    mnt = sub.add_parser("mount",
+                         help="mount the filer (FUSE, or local-dir sync)")
     mnt.add_argument("-filer", dest="filer", default="127.0.0.1:8888")
     mnt.add_argument("-filer.path", dest="filer_path", default="/")
     mnt.add_argument("-dir", dest="dir", required=True)
+    mnt.add_argument("-collection", default="")
+    mnt.add_argument("-mode", choices=("auto", "fuse", "sync"), default="auto",
+                     help="auto = FUSE when libfuse + /dev/fuse exist")
     mnt.add_argument("-scanSeconds", dest="scan_seconds", type=float, default=1.0)
     mnt.set_defaults(fn=cmd_mount)
 

@@ -138,6 +138,58 @@ class CpuCodec(Codec):
         return self._lib.rs_matmul(matrix, data)
 
 
+def build_pallas_gf_matmul(jax, n_out_rows: int, k: int, n_cols: int,
+                           tile: int, interpret: bool = False):
+    """The fused GF(2^8) matmul Pallas kernel: unpack → MXU bit-matmul →
+    mod-2 → repack, all inside VMEM per column tile.
+
+    Returns the raw pallas_call (callers jit it, or trace it inside a
+    shard_map body — pallas_call composes with shard_map, so the same fused
+    kernel is the per-device compute of the mesh codec).  Takes
+    (bitmat_planewise int8[8R, 8k], data uint8[k, n_cols]) → uint8[R, n_cols].
+    """
+    import jax.experimental.pallas as pl
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental.pallas import tpu as pltpu
+
+    T = min(tile, n_cols)
+    if n_cols % T:
+        raise ValueError(f"n_cols {n_cols} not a multiple of tile {T}")
+    R, K = n_out_rows, k
+    rb, kb = R * 8, K * 8
+
+    def kernel(bitmat_ref, data_ref, out_ref):
+        data = data_ref[...].astype(jnp.int32)  # (K, T)
+        # bit-plane-major unpack: row j*K+d = bit j of input byte row d
+        bits = jnp.concatenate(
+            [(data >> j) & 1 for j in range(8)], axis=0
+        ).astype(jnp.int8)  # (kb, T)
+        acc = lax.dot_general(
+            bitmat_ref[...],
+            bits,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # (rb, T), row i*R+p = bit i of output byte row p
+        obits = acc & 1
+        out = obits[:R, :]
+        for i in range(1, 8):
+            out = out | (obits[i * R : (i + 1) * R, :] << i)
+        out_ref[...] = out.astype(jnp.uint8)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_cols // T,),
+        in_specs=[
+            pl.BlockSpec((rb, kb), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, T), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, T), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, n_cols), jnp.uint8),
+        interpret=interpret,
+    )
+
+
 class TpuCodec(Codec):
     """JAX bit-matmul kernel; runs on TPU (or any jax backend).
 
@@ -247,49 +299,10 @@ class TpuCodec(Codec):
         key = ("pallas", n_out_rows, k, n_cols)
         fn = self._jit_cache.get(key)
         if fn is None:
-            jax = self._jax
-            jnp = jax.numpy
-            lax = jax.lax
-            import jax.experimental.pallas as pl
-            from jax.experimental.pallas import tpu as pltpu
-
-            T = min(self.pallas_tile, n_cols)
-            if n_cols % T:
-                raise ValueError(f"n_cols {n_cols} not a multiple of tile {T}")
-            R, K = n_out_rows, k
-            rb, kb = R * 8, K * 8
-
-            def kernel(bitmat_ref, data_ref, out_ref):
-                data = data_ref[...].astype(jnp.int32)  # (K, T)
-                # bit-plane-major unpack: row j*K+d = bit j of input byte row d
-                bits = jnp.concatenate(
-                    [(data >> j) & 1 for j in range(8)], axis=0
-                ).astype(jnp.int8)  # (kb, T)
-                acc = lax.dot_general(
-                    bitmat_ref[...],
-                    bits,
-                    dimension_numbers=(((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32,
-                )  # (rb, T), row i*R+p = bit i of output byte row p
-                obits = acc & 1
-                out = obits[:R, :]
-                for i in range(1, 8):
-                    out = out | (obits[i * R : (i + 1) * R, :] << i)
-                out_ref[...] = out.astype(jnp.uint8)
-
-            fn = jax.jit(
-                pl.pallas_call(
-                    kernel,
-                    grid=(n_cols // T,),
-                    in_specs=[
-                        pl.BlockSpec((rb, kb), lambda i: (0, 0), memory_space=pltpu.VMEM),
-                        pl.BlockSpec((K, T), lambda i: (0, i), memory_space=pltpu.VMEM),
-                    ],
-                    out_specs=pl.BlockSpec(
-                        (R, T), lambda i: (0, i), memory_space=pltpu.VMEM
-                    ),
-                    out_shape=jax.ShapeDtypeStruct((R, n_cols), jnp.uint8),
-                    interpret=self._pallas_interpret,
+            fn = self._jax.jit(
+                build_pallas_gf_matmul(
+                    self._jax, n_out_rows, k, n_cols, self.pallas_tile,
+                    self._pallas_interpret,
                 )
             )
             self._jit_cache[key] = fn

@@ -96,14 +96,35 @@ def write_dat_file(
             remaining = dat_size
 
             def copy_n(src, n):
+                from .encoder import _is_hole
+
                 left = n
                 while left > 0:
-                    buf = src.read(min(left, _COPY_CHUNK))
+                    step = min(left, _COPY_CHUNK)
+                    pos = src.tell()
+                    if pos + step > os.path.getsize(src.name):
+                        step_avail = os.path.getsize(src.name) - pos
+                        if step_avail <= 0:
+                            raise IOError(
+                                f"shard truncated: wanted {left} more bytes"
+                            )
+                        step = min(step, step_avail)
+                    # shard holes (sparse sealed volumes) stay holes in the
+                    # rebuilt .dat; the trailing truncate fixes the size
+                    if _is_hole(src.fileno(), pos, step):
+                        src.seek(step, 1)
+                        dat.seek(step, 1)
+                        left -= step
+                        continue
+                    buf = src.read(step)
                     if not buf:
                         raise IOError(
                             f"shard truncated: wanted {left} more bytes"
                         )
-                    dat.write(buf)
+                    if buf.count(0) == len(buf):
+                        dat.seek(len(buf), 1)
+                    else:
+                        dat.write(buf)
                     left -= len(buf)
 
             # strict >: an exact multiple of k*LARGE is laid out as small
@@ -123,6 +144,7 @@ def write_dat_file(
                         break
                     copy_n(src, to_read)
                     remaining -= to_read
+            dat.truncate(dat_size)
     finally:
         for f in inputs:
             f.close()

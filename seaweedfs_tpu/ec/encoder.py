@@ -34,21 +34,51 @@ from .constants import (
 )
 
 
+def _is_hole(fd: int, start: int, length: int) -> bool:
+    """True if [start, start+length) is entirely a filesystem hole.
+
+    SEEK_DATA turns sparse sealed volumes (preallocated space, punched
+    deletes) from gigabytes of kernel zero-fill reads into a single lseek;
+    filesystems without the op just report everything as data."""
+    import errno
+
+    # preserve the fd offset: callers may be buffered file objects whose
+    # tell() bookkeeping is built on the raw fd position
+    cur = os.lseek(fd, 0, os.SEEK_CUR)
+    try:
+        data_off = os.lseek(fd, start, os.SEEK_DATA)
+    except OSError as e:
+        return e.errno == errno.ENXIO  # no data at/after start == all hole
+    except (AttributeError, ValueError):
+        return False
+    finally:
+        os.lseek(fd, cur, os.SEEK_SET)
+    return data_off >= start + length
+
+
 def _read_block_columns(
     f, start: int, block_size: int, col_off: int, width: int, k: int, dat_size: int
-) -> np.ndarray:
-    """(k, width) matrix: column slice [col_off, col_off+width) of each of the
-    k consecutive block segments starting at ``start``; zero-padded past EOF."""
+) -> tuple[np.ndarray, bool]:
+    """((k, width) matrix, has_data): column slice [col_off, col_off+width)
+    of each of the k consecutive block segments starting at ``start``;
+    zero-padded past EOF. Hole segments stay zeros without being read;
+    has_data=False means every segment was a hole (or past EOF), so callers
+    can skip the encode outright."""
     out = np.zeros((k, width), dtype=np.uint8)
+    fd = f.fileno()
+    has_data = False
     for i in range(k):
         seg_start = start + i * block_size + col_off
         if seg_start >= dat_size:
             continue
         n = min(width, dat_size - seg_start)
+        if _is_hole(fd, seg_start, n):
+            continue
         f.seek(seg_start)
         buf = f.read(n)
         out[i, : len(buf)] = np.frombuffer(buf, dtype=np.uint8)
-    return out
+        has_data = True
+    return out, has_data
 
 
 def _work_items(
@@ -81,6 +111,7 @@ def write_ec_files(
     large_block_size: int = LARGE_BLOCK_SIZE,
     small_block_size: int = SMALL_BLOCK_SIZE,
     chunk_bytes: Optional[int] = None,
+    pipeline_stats: Optional[dict] = None,
 ) -> None:
     """Generate all shard files from ``base.dat`` (WriteEcFiles, :57).
 
@@ -104,41 +135,68 @@ def write_ec_files(
     outputs = [open(base_file_name + shard_ext(i), "wb") for i in range(k + m)]
     try:
         if hasattr(codec, "matmul_device"):
-            _encode_pipelined(dat, items, codec, outputs, dat_size)
+            _encode_pipelined(dat, items, codec, outputs, dat_size,
+                              stats=pipeline_stats)
         else:
             with open(dat, "rb") as f:
                 for start, block_size, col, width in items:
-                    data = _read_block_columns(
+                    data, has_data = _read_block_columns(
                         f, start, block_size, col, width, k, dat_size
                     )
+                    if not has_data or not data.any():
+                        # zeros encode to zeros: skip the matmul and leave
+                        # holes in the shard files (sparse sealed volumes —
+                        # preallocated space, punched deletes — stay sparse
+                        # and cheap; the truncate below fixes trailing sizes)
+                        for o in outputs:
+                            o.seek(width, 1)
+                        continue
                     parity = codec.encode(data)
                     for i in range(k):
                         outputs[i].write(data[i].tobytes())
                     for j in range(m):
                         outputs[k + j].write(parity[j].tobytes())
+        final = ec_shard_base_size(dat_size, k, large_block_size,
+                                   small_block_size)
+        for o in outputs:
+            o.truncate(final)
     finally:
         for o in outputs:
             o.close()
 
 
-def _overlap_pipeline(produce, compute, consume) -> None:
+def _overlap_pipeline(produce, compute, consume, stats: Optional[dict] = None) -> None:
     """Three-stage overlap shared by encode and rebuild: a reader thread
     runs `produce` (an iterator of host chunks), the main thread runs
     `compute` (async device dispatch), a writer thread runs `consume`
     (blocks on device results, writes files). Bounded queues give ~2
     chunks of lookahead; any stage failing drains the others so every
-    thread exits and the first error is re-raised."""
+    thread exits and the first error is re-raised.
+
+    With a ``stats`` dict, per-stage BUSY time (time inside the stage
+    callable, excluding queue blocking) and wall time are recorded, plus
+    ``efficiency`` = max(stage busy) / wall — 1.0 means the slowest stage
+    fully hides the other two, i.e. wall ≈ max(stage) rather than
+    Σ(stages), which is the whole point vs the reference's serial
+    read→Encode→write loop (ec_encoder.go:162-192)."""
     import queue
     import threading
+    import time as _time
 
     read_q: queue.Queue = queue.Queue(maxsize=2)
     write_q: queue.Queue = queue.Queue(maxsize=2)
     errors: list[BaseException] = []
+    busy = {"read": 0.0, "compute": 0.0, "write": 0.0}
+    t_wall = _time.perf_counter()
 
     def reader():
         try:
-            for item in produce():
-                if errors:
+            it = produce()
+            while True:
+                t0 = _time.perf_counter()
+                item = next(it, None)
+                busy["read"] += _time.perf_counter() - t0
+                if item is None or errors:
                     return
                 read_q.put(item)
         except BaseException as e:  # surfaced after join
@@ -152,7 +210,9 @@ def _overlap_pipeline(produce, compute, consume) -> None:
                 got = write_q.get()
                 if got is None:
                     return
+                t0 = _time.perf_counter()
                 consume(got)
+                busy["write"] += _time.perf_counter() - t0
         except BaseException as e:
             errors.append(e)
             while write_q.get() is not None:  # drain so the feeder unblocks
@@ -170,7 +230,10 @@ def _overlap_pipeline(produce, compute, consume) -> None:
             if errors:
                 continue  # keep draining so the reader can finish
             try:
-                write_q.put(compute(got))
+                t0 = _time.perf_counter()
+                out = compute(got)
+                busy["compute"] += _time.perf_counter() - t0
+                write_q.put(out)
             except BaseException as e:
                 errors.append(e)
     finally:
@@ -185,9 +248,19 @@ def _overlap_pipeline(produce, compute, consume) -> None:
         rt.join()
     if errors:
         raise errors[0]
+    if stats is not None:
+        wall = _time.perf_counter() - t_wall
+        stats.update(
+            wall_s=wall,
+            read_busy_s=busy["read"],
+            compute_busy_s=busy["compute"],
+            write_busy_s=busy["write"],
+            efficiency=max(busy.values()) / wall if wall > 0 else 0.0,
+        )
 
 
-def _encode_pipelined(dat, items, codec, outputs, dat_size: int) -> None:
+def _encode_pipelined(dat, items, codec, outputs, dat_size: int,
+                      stats: Optional[dict] = None) -> None:
     k, m = codec.data_shards, codec.parity_shards
     align = codec.alignment() if hasattr(codec, "alignment") else 1
 
@@ -195,16 +268,16 @@ def _encode_pipelined(dat, items, codec, outputs, dat_size: int) -> None:
         with open(dat, "rb") as f:
             for it in items:
                 start, block_size, col, width = it
-                yield (
-                    it,
-                    _read_block_columns(
-                        f, start, block_size, col, width, k, dat_size
-                    ),
+                data, has_data = _read_block_columns(
+                    f, start, block_size, col, width, k, dat_size
                 )
+                yield (it, data, has_data)
 
     def compute(got):
-        it, data = got
+        it, data, has_data = got
         width = it[3]
+        if not has_data or not data.any():
+            return it, data, None  # zero chunk: parity is zeros, skip device
         piece = data
         if width % align:
             padded = align * -(-width // align)
@@ -216,13 +289,17 @@ def _encode_pipelined(dat, items, codec, outputs, dat_size: int) -> None:
 
     def consume(got):
         (_, _, _, width), data, parity_dev = got
+        if parity_dev is None:
+            for o in outputs:  # keep sparse regions sparse (holes)
+                o.seek(width, 1)
+            return
         parity = np.asarray(parity_dev)[:, :width]  # blocks until ready
         for i in range(k):
             outputs[i].write(data[i, :width].tobytes())
         for j in range(m):
             outputs[k + j].write(parity[j].tobytes())
 
-    _overlap_pipeline(produce, compute, consume)
+    _overlap_pipeline(produce, compute, consume, stats=stats)
 
 
 def rebuild_ec_files(
@@ -268,15 +345,28 @@ def rebuild_ec_files(
             while pos < shard_size:
                 width = min(chunk, shard_size - pos)
                 shards: list[Optional[np.ndarray]] = [None] * total
+                zero = True
                 for sid, fh in ins.items():
+                    if _is_hole(fh.fileno(), pos, width):
+                        shards[sid] = np.zeros(width, dtype=np.uint8)
+                        continue
                     fh.seek(pos)
-                    shards[sid] = np.frombuffer(
-                        fh.read(width), dtype=np.uint8
-                    )
+                    arr = np.frombuffer(fh.read(width), dtype=np.uint8)
+                    zero = zero and not arr.any()
+                    shards[sid] = arr
+                if zero:
+                    # all-zero columns reconstruct to zeros: keep shard
+                    # holes (sparse sealed volumes) as holes
+                    for sid in missing:
+                        outs[sid].seek(width, 1)
+                    pos += width
+                    continue
                 rebuilt = codec.reconstruct(shards)
                 for sid in missing:
                     outs[sid].write(rebuilt[sid].tobytes())
                 pos += width
+        for sid in missing:
+            outs[sid].truncate(shard_size)
     finally:
         for fh in ins.values():
             fh.close()
@@ -325,20 +415,30 @@ def _rebuild_pipelined(codec, ins, outs, missing, shard_size, chunk) -> None:
             width = min(chunk, shard_size - pos)
             padded = -(-width // align) * align  # zeros encode to zeros
             buf = np.zeros((k, padded), dtype=np.uint8)
+            has_data = False
             for row, sid in enumerate(first_k):
+                if _is_hole(ins[sid].fileno(), pos, width):
+                    continue
                 ins[sid].seek(pos)
                 buf[row, :width] = np.frombuffer(
                     ins[sid].read(width), dtype=np.uint8
                 )
-            yield (width, buf)
+                has_data = True
+            yield (width, buf, has_data)
             pos += width
 
     def compute(got):
-        width, buf = got
+        width, buf, has_data = got
+        if not has_data or not buf.any():
+            return width, None  # zeros reconstruct to zeros
         return width, codec.matmul_device(rows, codec.device_put(buf))
 
     def consume(got):
         width, out_dev = got
+        if out_dev is None:
+            for sid in missing:
+                outs[sid].seek(width, 1)
+            return
         out = np.asarray(out_dev)[:, :width]  # blocks until ready
         for j, sid in enumerate(missing):
             outs[sid].write(out[j].tobytes())

@@ -24,14 +24,18 @@ from .codec import Codec
 from .constants import DATA_SHARDS, PARITY_SHARDS
 
 
-def factor_mesh(n_devices: int) -> tuple[int, int, int]:
-    """Split n into (dp, sp, tp) axis sizes, preferring balance."""
-    dp = sp = tp = 1
-    n = n_devices
-    # tp must divide the 8k-bit contraction dim (80 for RS(10,4)); keep it
-    # small — the psum is the only collective and dp/sp shard for free
-    if n % 2 == 0:
-        tp, n = 2, n // 2
+def factor_mesh(n_devices: int, tp: int = 1) -> tuple[int, int, int]:
+    """Split n into (dp, sp, tp) axis sizes, preferring balance.
+
+    tp defaults to 1: the RS contraction dim is tiny (80 bits for RS(10,4)),
+    so splitting it buys nothing and costs a psum per chunk, while dp/sp
+    shard columns with NO collectives and let each device run the fused
+    Pallas kernel at its full single-chip rate. tp>1 stays supported (the
+    psum formulation) for callers that want the contraction split."""
+    if n_devices % tp:
+        raise ValueError(f"tp={tp} does not divide n_devices={n_devices}")
+    dp = sp = 1
+    n = n_devices // tp
     while n % 2 == 0:
         if dp <= sp:
             dp *= 2
@@ -42,7 +46,7 @@ def factor_mesh(n_devices: int) -> tuple[int, int, int]:
     return dp, sp, tp
 
 
-def build_mesh(n_devices: int | None = None):
+def build_mesh(n_devices: int | None = None, tp: int = 1):
     import jax
     from jax.sharding import Mesh
 
@@ -50,7 +54,7 @@ def build_mesh(n_devices: int | None = None):
     if n_devices is None:
         n_devices = len(devices)
     devices = np.array(devices[:n_devices])
-    dp, sp, tp = factor_mesh(n_devices)
+    dp, sp, tp = factor_mesh(n_devices, tp)
     return Mesh(devices.reshape(dp, sp, tp), ("dp", "sp", "tp"))
 
 
@@ -143,9 +147,12 @@ class MeshCodec(Codec):
     int32 psum over ICI, then reduced mod 2). Shard bytes are identical to
     every other backend.
 
-    The per-device compute uses the XLA bit-matmul formulation; on CPU CI
-    meshes that is the only option, and on a real pod slice XLA fuses it per
-    shard. (The fused Pallas kernel is single-chip-tuned; see TpuCodec.)
+    Per-device compute: with tp == 1 on TPU devices, each device runs the
+    SAME fused Pallas kernel as the single-chip TpuCodec on its column slice
+    (pallas_call composes with shard_map), so the mesh path inherits the
+    full single-chip rate with zero collectives. With tp > 1 (or on CPU CI
+    meshes) the XLA bit-matmul formulation runs per shard, with the partial
+    GF(2) counts psum'd over ICI.
     """
 
     def __init__(
@@ -155,6 +162,9 @@ class MeshCodec(Codec):
         mesh=None,
         n_devices: int | None = None,
         chunk_bytes: int = 8 * 1024 * 1024,
+        use_pallas: bool | None = None,
+        pallas_tile: int = 32 * 1024,
+        pallas_interpret: bool = False,
     ):
         super().__init__(data_shards, parity_shards)
         import jax
@@ -166,12 +176,27 @@ class MeshCodec(Codec):
         self._col_axes = ("dp", "sp")
         self._n_cols_shards = self.mesh.shape["dp"] * self.mesh.shape["sp"]
         self._tp = self.mesh.shape["tp"]
+        if use_pallas is None:
+            try:
+                use_pallas = all(
+                    d.platform == "tpu" for d in self.mesh.devices.flat
+                )
+            except Exception:
+                use_pallas = False
+        # the fused kernel computes whole GF bytes per tile; a tp split needs
+        # int partial sums across devices, which only the XLA body expresses
+        self.use_pallas = use_pallas and self._tp == 1
+        self.pallas_tile = pallas_tile
+        self._pallas_interpret = pallas_interpret
         self._jit_cache: dict = {}
         self._bitmat_cache: dict = {}
 
     # -- device placement (the streaming encoder's overlap pipeline) ---------
     def alignment(self) -> int:
         """Column widths fed to matmul_device must be multiples of this."""
+        if self.use_pallas:
+            # each device's local slice must be a whole number of kernel tiles
+            return self._n_cols_shards * self.pallas_tile
         return self._n_cols_shards * 8
 
     def device_put(self, data: np.ndarray):
@@ -183,23 +208,30 @@ class MeshCodec(Codec):
         )
 
     def _stacked_bitmat(self, matrix: np.ndarray):
-        key = matrix.tobytes()
+        key = (matrix.tobytes(), self.use_pallas)
         cached = self._bitmat_cache.get(key)
         if cached is None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            bm = gf.gf_matrix_to_bit_matrix(matrix).astype(np.int8)  # (8R, 8k)
-            eight_r, eight_k = bm.shape
-            if eight_k % self._tp:
-                raise ValueError(
-                    f"contraction dim {eight_k} not divisible by tp={self._tp}"
+            if self.use_pallas:
+                # planewise expansion, replicated on every device (tiny)
+                bm = gf.bit_matrix_planewise(matrix).astype(np.int8)
+                cached = self._jax.device_put(
+                    bm, NamedSharding(self.mesh, P(None, None))
                 )
-            stacked = bm.reshape(eight_r, self._tp, eight_k // self._tp).transpose(
-                1, 0, 2
-            )  # (tp, 8R, 8k/tp)
-            cached = self._jax.device_put(
-                stacked, NamedSharding(self.mesh, P("tp", None, None))
-            )
+            else:
+                bm = gf.gf_matrix_to_bit_matrix(matrix).astype(np.int8)  # (8R, 8k)
+                eight_r, eight_k = bm.shape
+                if eight_k % self._tp:
+                    raise ValueError(
+                        f"contraction dim {eight_k} not divisible by tp={self._tp}"
+                    )
+                stacked = bm.reshape(
+                    eight_r, self._tp, eight_k // self._tp
+                ).transpose(1, 0, 2)  # (tp, 8R, 8k/tp)
+                cached = self._jax.device_put(
+                    stacked, NamedSharding(self.mesh, P("tp", None, None))
+                )
             self._bitmat_cache[key] = cached
         return cached
 
@@ -212,6 +244,34 @@ class MeshCodec(Codec):
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             col_axes = self._col_axes
+
+            if self.use_pallas:
+                from .codec import build_pallas_gf_matmul
+
+                tile = self.pallas_tile
+                interpret = self._pallas_interpret
+
+                def pallas_body(bitmat, data):
+                    # data: the device-local (k, n_loc) column slice; the
+                    # fused kernel runs at full single-chip rate per device,
+                    # no collectives (columns are embarrassingly parallel)
+                    n_loc = data.shape[1]
+                    return build_pallas_gf_matmul(
+                        jax, n_out_rows, k, n_loc, tile, interpret
+                    )(bitmat, data)
+
+                mapped = _shard_map(
+                    pallas_body,
+                    mesh=self.mesh,
+                    in_specs=(P(None, None), P(None, col_axes)),
+                    out_specs=P(None, col_axes),
+                )
+                fn = jax.jit(
+                    mapped,
+                    out_shardings=NamedSharding(self.mesh, P(None, col_axes)),
+                )
+                self._jit_cache[key] = fn
+                return fn
 
             def body(bitmat_slices, data):
                 # bitmat_slices: local (1, 8R, 8k/tp); data: local (k, n_loc)

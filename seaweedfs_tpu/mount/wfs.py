@@ -238,17 +238,26 @@ class FileHandle:
             self._commit_chunks(chunks)
 
     def truncate(self, length: int = 0) -> None:
-        """Supported: truncate-to-zero (drop all chunks) and logical
-        extension; mid-file truncation would need chunk clipping."""
+        """Truncate-to-zero drops all chunks; extension is logical;
+        mid-file truncation keeps the [0, length) prefix by re-writing it
+        as fresh chunks (correct for cipher'd chunks too, since the read
+        path decrypts — chunk-clipping in metadata alone would not be).
+
+        The prefix chunks are UPLOADED BEFORE the entry commit: a failure
+        anywhere leaves the old entry (and the data) intact instead of
+        committing an emptied chunk list first and losing the file."""
+        new_chunks: list[FileChunk] = []
+        if length > 0:
+            if length >= self.size():
+                return  # logical extension / no-op
+            prefix = self.read(0, length)
+            new_chunks = self.wfs.save_data_as_chunks(prefix, 0)
         with self._lock:
-            if length == 0:
-                self.dirty = ContinuousIntervals()
-                self.entry.chunks = []
-                self.wfs.client.create_entry(self.path, self.entry.to_dict())
-                if self.wfs.meta_cache:
-                    self.wfs.meta_cache.invalidate(self.path)
-            elif length < self.size():
-                raise WfsError("mid-file truncate not supported")
+            self.dirty = ContinuousIntervals()
+            self.entry.chunks = new_chunks
+            self.wfs.client.create_entry(self.path, self.entry.to_dict())
+            if self.wfs.meta_cache:
+                self.wfs.meta_cache.invalidate(self.path)
 
     # -- read path -----------------------------------------------------------
     def read(self, offset: int, size: int) -> bytes:

@@ -365,6 +365,9 @@ struct Worker {
   std::mutex done_mu;
   std::vector<std::pair<Conn*, bool>> done;
   std::atomic<int> inflight{0};
+  // set when teardown abandons a wedged proxy thread: the Worker must be
+  // leaked, not freed (the thread will still touch done_mu/notify_fd)
+  std::atomic<bool> leak{false};
   std::unordered_map<int, Conn*> conns;
 };
 
@@ -961,6 +964,14 @@ static int handle_post(Worker* w, Conn* c, const Req& r, const Fid& f) {
 
   const uint8_t* data = r.body;
   int64_t dlen = r.content_length;
+  // the needle `size` field is int32; bound bodies well below it (the
+  // Python path fails loudly at struct-pack time — silently casting here
+  // would poison the map/idx with a negative size). Big objects go
+  // through chunking (operation.submit -maxMB / the filer) anyway.
+  if (dlen > ((int64_t)1 << 30))
+    return reply_json(w, c, 413,
+                      "{\"error\": \"body too large for a single needle\"}")
+               ? 0 : -1;
   uint8_t flags = FLAG_HAS_LAST_MODIFIED;  // volume_server.py _h_post always sets
   std::string name = r.name.substr(0, 255);
   std::string mime = r.mime.substr(0, 255);
@@ -1342,11 +1353,29 @@ static void worker_loop(Worker* w) {
       if (drop && !transferred) close_conn(w, c);
     }
   }
-  // teardown: wait for proxy threads still holding our Conn pointers
-  while (w->inflight.load() > 0) usleep(10000);
+  // teardown: wait for proxy threads still holding our Conn pointers.
+  // Completions queued after the loop exited must be drained HERE (the
+  // notify handler no longer runs) or inflight never reaches zero and
+  // turbo_stop deadlocks. Bounded: a proxy thread wedged on a dead
+  // backend is abandoned (conn leaked) rather than hanging shutdown.
+  for (int spins = 0; w->inflight.load() > 0 && spins < 1500; spins++) {
+    std::vector<std::pair<Conn*, bool>> done;
+    {
+      std::lock_guard<std::mutex> lk(w->done_mu);
+      done.swap(w->done);
+    }
+    for (auto& [c, ok] : done) {
+      w->inflight--;
+      close(c->fd);
+      delete c;
+    }
+    if (w->inflight.load() > 0) usleep(10000);
+  }
+  if (w->inflight.load() > 0) w->leak.store(true);
   {
     std::lock_guard<std::mutex> lk(w->done_mu);
     for (auto& [c, ok] : w->done) {
+      w->inflight--;
       close(c->fd);
       delete c;
     }
@@ -1359,7 +1388,9 @@ static void worker_loop(Worker* w) {
   w->conns.clear();
   if (w->listen_fd >= 0) close(w->listen_fd);
   if (w->stop_fd >= 0) close(w->stop_fd);
-  if (w->notify_fd >= 0) close(w->notify_fd);
+  // a leaked worker keeps notify_fd open: the wedged proxy thread will
+  // still write it, and the fd number must not be recycled under it
+  if (w->notify_fd >= 0 && !w->leak.load()) close(w->notify_fd);
   if (w->epfd >= 0) close(w->epfd);
 }
 
@@ -1417,7 +1448,7 @@ long long turbo_start(const char* bind_ip, int port, const char* backend_ip,
     e->stop_fds.push_back(w->stop_fd);
     e->workers.emplace_back([w] {
       worker_loop(w);
-      delete w;
+      if (!w->leak.load()) delete w;  // leaked workers outlive wedged proxies
     });
   }
   return (long long)(intptr_t)e;

@@ -87,7 +87,7 @@ def upload_data(
         headers["Authorization"] = f"Bearer {jwt}"
     status, body, _ = http_bytes_headers(
         "POST", f"http://{url}/{fid}{q}", body=data, timeout=60,
-        headers=headers,
+        headers=headers, idempotent=True,  # same fid+bytes = no-op overwrite
     )
     if status >= 300:
         raise RuntimeError(f"upload {fid}: HTTP {status} {body[:200]!r}")

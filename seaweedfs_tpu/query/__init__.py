@@ -4,3 +4,29 @@ projection of CSV / JSON-lines object content."""
 
 from .engine import run_query  # noqa: F401
 from .sql import parse_sql, run_sql  # noqa: F401
+
+
+def execute_request(data: bytes, req: dict) -> tuple[int, dict]:
+    """Run one query request dict against raw bytes → (status, payload).
+
+    The shared execution core behind the filer's /_query and the volume
+    server's data-local /_query (volume_grpc_query.go runs next to the
+    needle bytes; this is that execution, callable from either daemon)."""
+    if req.get("sql"):
+        from .sql import SqlError, run_sql
+
+        try:
+            rows = run_sql(
+                data, req["sql"], input_format=req.get("input", "json")
+            )
+        except SqlError as e:
+            return 400, {"error": f"bad sql: {e}"}
+    else:
+        rows = run_query(
+            data,
+            input_format=req.get("input", "json"),
+            select=req.get("select"),
+            where=req.get("where"),
+            limit=int(req.get("limit", 0)),
+        )
+    return 200, {"rows": rows, "count": len(rows)}

@@ -153,11 +153,20 @@ class _Parser:
         if not isinstance(pat, str):
             raise SqlError("LIKE needs a string pattern")
         # the engine's substring ops cover the common S3-Select shapes;
-        # %x% → contains, x% → starts_with, exact → equals
+        # %x% → contains, x% → starts_with, exact → equals. Any wildcard
+        # left in the BODY after stripping the edges (e.g. '%a%b%') has no
+        # substring-op equivalent — fail loudly rather than match a
+        # literal '%' (ADVICE r2)
         if pat.startswith("%") and pat.endswith("%") and len(pat) >= 2:
-            return {"field": field, "op": "contains", "value": pat[1:-1]}
+            body = pat[1:-1]
+            if "%" in body or "_" in body:
+                raise SqlError(f"unsupported LIKE pattern {pat!r}")
+            return {"field": field, "op": "contains", "value": body}
         if pat.endswith("%"):
-            return {"field": field, "op": "starts_with", "value": pat[:-1]}
+            body = pat[:-1]
+            if "%" in body or "_" in body:
+                raise SqlError(f"unsupported LIKE pattern {pat!r}")
+            return {"field": field, "op": "starts_with", "value": body}
         if "%" in pat or "_" in pat:
             raise SqlError(f"unsupported LIKE pattern {pat!r}")
         return {"field": field, "op": "=", "value": pat}

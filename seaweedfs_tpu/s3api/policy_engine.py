@@ -76,8 +76,16 @@ def _match_principal(principals: list[str], who: str) -> bool:
     for p in principals:
         if p == "*" or p == who:
             return True
-        # arn:aws:iam::123:user/name style: match the trailing name
-        if p.rsplit("/", 1)[-1] == who:
+        # arn:aws:iam::123:user/name style: match the trailing name — but
+        # ONLY for actual IAM ARNs, and never for the anonymous identity
+        # (who == ""): a bare name containing '/' must not alias into an
+        # ARN match, and 'arn:...:user/' must not grant anonymous (ADVICE r2)
+        if (
+            who != ""
+            and p.startswith("arn:aws:iam::")
+            and "/" in p
+            and p.rsplit("/", 1)[-1] == who
+        ):
             return True
     return False
 

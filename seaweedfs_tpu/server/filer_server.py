@@ -40,7 +40,7 @@ from ..filer.filer import Filer
 from ..filer.filerstore import NotFoundError, SqliteStore
 from ..util import glog
 from ..wdclient import MasterClient
-from .http_util import JsonHandler, start_server
+from .http_util import JsonHandler, http_json, start_server
 
 
 class _VidLookup:
@@ -254,36 +254,44 @@ class FilerServer:
         return 200, self.metrics.expose().encode()
 
     def _h_query(self, h, path, q, body):
-        """S3-Select-ish scan of a stored CSV/JSON file
-        (volume_grpc_query.go analog at the filer level)."""
-        from ..query import run_query
+        """S3-Select-ish scan of a stored CSV/JSON file.
 
+        Data locality first: a single-chunk plain entry is queried ON the
+        volume server holding its needle (POST /_query {fid,...} —
+        volume_grpc_query.go:12), so the object bytes never cross the
+        network. Multi-chunk / cipher'd entries (row boundaries span
+        chunks; keys live here) fall back to filer-side execution."""
         req = json.loads(body)
         target = req.get("path", "")
         try:
             entry = self.filer.find_entry(target)
         except NotFoundError:
             return 404, {"error": f"{target} not found"}
-        data = self._read_range(entry, 0, entry.file_size())
-        if req.get("sql"):
-            # S3-Select style: SELECT ... FROM s3object WHERE ... LIMIT n
-            from ..query.sql import SqlError, run_sql
-
+        chunks = entry.chunks or []
+        if (
+            len(chunks) == 1
+            and not chunks[0].cipher_key
+            and not chunks[0].is_chunk_manifest
+        ):
+            fid = chunks[0].file_id
             try:
-                rows = run_sql(
-                    data, req["sql"], input_format=req.get("input", "json")
-                )
-            except SqlError as e:
-                return 400, {"error": f"bad sql: {e}"}
-        else:
-            rows = run_query(
-                data,
-                input_format=req.get("input", "json"),
-                select=req.get("select"),
-                where=req.get("where"),
-                limit=int(req.get("limit", 0)),
-            )
-        return 200, {"rows": rows, "count": len(rows)}
+                vid = int(fid.split(",")[0])
+                for loc in self._lookup.lookup(vid):
+                    fwd = dict(req)
+                    fwd["fid"] = fid
+                    fwd.pop("path", None)
+                    r = http_json(
+                        "POST", f"http://{loc['url']}/_query", fwd, timeout=30
+                    )
+                    if "rows" in r or r.get("error", "").startswith("bad sql"):
+                        status = 400 if "rows" not in r else 200
+                        return status, r
+            except Exception as e:  # noqa: BLE001 — locality is best-effort
+                glog.V(1).info("data-local query fell back: %s", e)
+        data = self._read_range(entry, 0, entry.file_size())
+        from ..query import execute_request
+
+        return execute_request(data, req)
 
     @staticmethod
     def _sigs(q) -> Optional[list[int]]:

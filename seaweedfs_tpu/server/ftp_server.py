@@ -141,10 +141,21 @@ class _Session(threading.Thread):
             self.send(331, "Password required.")
 
     def do_PASS(self, arg):
+        import hmac
+
         if not self.srv.users:
             self.authed_user = self.pending_user or "anonymous"
             self.send(230, "Login successful.")
-        elif self.srv.users.get(self.pending_user) == arg:
+            return
+        # constant-time compare that runs for known AND unknown users
+        # (ADVICE r2): unknown accounts compare against a dummy that can
+        # never match, so timing doesn't enumerate accounts, and a user
+        # legitimately configured with an empty password still logs in
+        known = (self.pending_user or "") in self.srv.users
+        expect = self.srv.users.get(self.pending_user or "")
+        probe = expect if known else "\x00never-matches"
+        ok = hmac.compare_digest(probe, arg or "")
+        if known and ok:
             self.authed_user = self.pending_user
             self.send(230, "Login successful.")
         else:

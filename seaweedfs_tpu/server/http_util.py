@@ -242,12 +242,16 @@ class _NoDelayHTTPConnection:
         return cls._cls
 
 
+_IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "PUT", "DELETE"})
+
+
 def _pooled_request(
     method: str,
     url: str,
     body: Optional[bytes],
     headers: Optional[dict],
     timeout: float,
+    idempotent: bool = False,
 ) -> tuple[int, bytes, dict]:
     import http.client
 
@@ -257,6 +261,13 @@ def _pooled_request(
     if conns is None:
         conns = _pool_local.conns = {}
     path = u.path + (f"?{u.query}" if u.query else "")
+    # The stale-socket retry can double-execute a request the server already
+    # received (a reset can arrive after execution), so it is limited to
+    # idempotent methods — mirroring Go net/http shouldRetryRequest — plus
+    # POSTs the caller explicitly marks idempotent (fid-addressed uploads:
+    # re-writing the same fid+bytes is a no-op overwrite). A retried
+    # /dir/assign would leak a file id (ADVICE r2).
+    may_retry = method in _IDEMPOTENT_METHODS or idempotent
     last_err: Optional[Exception] = None
     for attempt in (0, 1):
         conn = conns.get(key)
@@ -284,13 +295,14 @@ def _pooled_request(
             BrokenPipeError,
         ) as e:
             # idle-close race on a REUSED socket: the peer closed before
-            # sending a status line — safe to re-dial once. Timeouts and
-            # mid-response failures are NOT retried (the request may have
-            # executed; re-sending would double-assign/double-publish).
+            # sending a status line — safe to re-dial once for idempotent
+            # requests. Timeouts and mid-response failures are NOT retried
+            # (the request may have executed; re-sending would
+            # double-assign/double-publish).
             conn.close()
             conns.pop(key, None)
             last_err = e
-            if fresh or attempt:
+            if fresh or attempt or not may_retry:
                 raise
         except (http.client.HTTPException, OSError):
             conn.close()
@@ -336,9 +348,11 @@ def http_bytes(
     body: Optional[bytes] = None,
     timeout: float = 30.0,
     headers: Optional[dict] = None,
+    idempotent: bool = False,
 ) -> tuple[int, bytes]:
     status, data, _ = http_bytes_headers(
-        method, url, body=body, timeout=timeout, headers=headers
+        method, url, body=body, timeout=timeout, headers=headers,
+        idempotent=idempotent,
     )
     return status, data
 
@@ -349,11 +363,15 @@ def http_bytes_headers(
     body: Optional[bytes] = None,
     timeout: float = 30.0,
     headers: Optional[dict] = None,
+    idempotent: bool = False,
 ) -> tuple[int, bytes, dict]:
     """Like http_bytes but also returns response headers (some admin
-    endpoints carry metadata such as X-Compaction-Revision there)."""
+    endpoints carry metadata such as X-Compaction-Revision there).
+    ``idempotent`` opts a POST into the stale-socket one-shot retry
+    (fid-addressed uploads are safe to re-send; assigns are not)."""
     if url.startswith("http://"):
-        return _pooled_request(method, url, body, headers, timeout)
+        return _pooled_request(method, url, body, headers, timeout,
+                               idempotent=idempotent)
     # https (or anything else) stays on urllib with its default TLS context
     req = urllib.request.Request(
         url, data=body, method=method, headers=headers or {}

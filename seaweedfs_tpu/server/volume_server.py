@@ -480,7 +480,8 @@ class VolumeServer:
                 f"&{extra}" if extra else ""
             )
             status, resp = http_bytes(
-                method, full, body if method == "POST" else None, headers=fwd
+                method, full, body if method == "POST" else None, headers=fwd,
+                idempotent=True,  # replicate-by-fid re-sends are no-ops
             )
             if status >= 300:
                 errors.append(f"{url}: {status} {resp[:100]!r}")
@@ -959,6 +960,41 @@ class VolumeServer:
             "append_ns": append_ns,
         }
 
+    def _h_query(self, h, path, q, body):
+        """Data-local query: execute an S3-Select-ish request against a
+        needle THIS server holds, without shipping the bytes anywhere
+        (volume_grpc_query.go:12 — the reference runs queries beside the
+        needle too; the filer delegates here per chunk).
+
+        Queries RETURN needle content, so they pass the same IP guard +
+        fid-scoped read-JWT gate as GET (a query must never become a
+        read-auth bypass)."""
+        if not self.guard.allowed(h.client_address[0]):
+            return 403, {"error": "ip not allowed"}
+        req = json.loads(body)
+        fid = req.get("fid", "")
+        if self.jwt_read_key:
+            from ..security import verify_fid_jwt
+
+            token = req.get("auth", "") or q.get("auth", "")
+            ah = h.headers.get("Authorization", "")
+            if not token and ah.startswith("Bearer "):
+                token = ah[len("Bearer "):]
+            if not verify_fid_jwt(self.jwt_read_key, token, fid):
+                return 401, {"error": "unauthorized read"}
+        try:
+            vid = int(fid.split(",")[0])
+        except (ValueError, IndexError):
+            return 400, {"error": f"bad fid {fid!r}"}
+        if self.store.find_volume(vid) is None and self.store.find_ec_volume(vid) is None:
+            return 404, {"error": f"volume {vid} not local"}
+        status, data = self._fetch_fid(fid)
+        if status != 200:
+            return status, {"error": f"needle {fid}: HTTP {status}"}
+        from ..query import execute_request
+
+        return execute_request(data, req)
+
     def _h_metrics(self, h, path, q, body):
         return 200, self.metrics.expose().encode()
 
@@ -1103,6 +1139,7 @@ class VolumeServer:
                 ("GET", "/admin/file", vs._h_file),
                 ("GET", "/admin/needle_ids", vs._h_needle_ids),
                 ("GET", "/admin/needle_info", vs._h_needle_info),
+                ("POST", "/_query", vs._h_query),
                 ("GET", "/status", vs._h_status),
                 ("GET", "/ui", vs._h_ui),
                 ("GET", "/metrics", vs._h_metrics),

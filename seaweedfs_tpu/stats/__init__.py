@@ -2,6 +2,7 @@
 counters/gauges/histograms with a text exposition endpoint."""
 
 from .metrics import (  # noqa: F401
+    MetricsPusher,
     Counter,
     Gauge,
     Histogram,

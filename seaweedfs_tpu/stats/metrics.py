@@ -183,3 +183,49 @@ def memory_status() -> dict:
     except OSError:
         pass
     return out
+
+
+class MetricsPusher:
+    """Periodic push of the registry's exposition to a Prometheus push
+    gateway (stats/metrics.go:69 startPushingMetric — the reference pushes
+    with prometheus/push when -metricsAddress is set; pull via /metrics
+    stays available either way)."""
+
+    def __init__(self, registry: Registry, gateway_url: str, job: str,
+                 instance: str = "", interval_seconds: float = 15.0):
+        self.registry = registry
+        url = gateway_url.rstrip("/")
+        if not url.startswith("http"):
+            url = "http://" + url
+        self.url = f"{url}/metrics/job/{job}"
+        if instance:
+            self.url += f"/instance/{instance}"
+        self.interval = interval_seconds
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def push_once(self) -> bool:
+        from ..server.http_util import http_bytes
+
+        try:
+            status, _ = http_bytes(
+                "POST", self.url, body=self.registry.expose().encode(),
+                headers={"Content-Type": "text/plain"}, timeout=10,
+            )
+            return status < 300
+        except Exception:
+            return False  # gateway down: keep trying, pull still works
+
+    def start(self) -> "MetricsPusher":
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.push_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)

@@ -28,3 +28,25 @@ except ImportError:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_http_pool():
+    """Drop pooled keep-alive sockets between tests: ephemeral test ports
+    get REUSED by later fixtures, and a stale pooled socket for a reused
+    (host, port) would surface as a BrokenPipeError on the first
+    non-idempotent request of an unrelated test."""
+    yield
+    from seaweedfs_tpu.server import http_util
+
+    conns = getattr(http_util._pool_local, "conns", None)
+    if conns:
+        for c in conns.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        conns.clear()

@@ -237,3 +237,117 @@ def test_backup_aborts_on_midrun_compaction(cluster, tmp_path, monkeypatch):
     # a clean rerun converges
     r = vb.backup_volume(master.url, vid, backup_dir)
     assert r["writes"] >= 3 and r["copied_bytes"] > 0
+
+
+# -- round-2 advisor findings -------------------------------------------------
+
+def test_like_interior_wildcards_rejected():
+    """LIKE '%a%b%' has no substring-op equivalent; it must raise, not
+    silently match a literal '%' (ADVICE r2)."""
+    from seaweedfs_tpu.query.sql import SqlError, parse_sql
+
+    for pat in ("%a%b%", "a%b%", "%a_b%", "a_b%"):
+        with pytest.raises(SqlError):
+            parse_sql(f"SELECT * FROM s3object WHERE name LIKE '{pat}'")
+    # the supported shapes still parse
+    _, where, _ = parse_sql("SELECT * FROM s3object WHERE name LIKE '%ab%'")
+    assert where == {"field": "name", "op": "contains", "value": "ab"}
+    _, where, _ = parse_sql("SELECT * FROM s3object WHERE name LIKE 'ab%'")
+    assert where == {"field": "name", "op": "starts_with", "value": "ab"}
+
+
+def test_policy_principal_arn_matching_tightened():
+    """Trailing-name ARN matching must require a real IAM ARN and must
+    never match the anonymous identity (ADVICE r2)."""
+    from seaweedfs_tpu.s3api.policy_engine import _match_principal
+
+    assert _match_principal(["arn:aws:iam::123:user/alice"], "alice")
+    assert _match_principal(["*"], "")
+    assert _match_principal(["bob"], "bob")
+    # NOT an IAM arn: a slash-y name must not alias into a match
+    assert not _match_principal(["something/alice"], "alice")
+    # malformed arn ending in '/' must not match anonymous
+    assert not _match_principal(["arn:aws:iam::123:user/"], "")
+    # anonymous only ever matches the literal *
+    assert not _match_principal(["arn:aws:iam::123:user/alice"], "")
+
+
+def test_ftp_pass_unknown_user_rejected():
+    from seaweedfs_tpu.server.ftp_server import FtpServer
+
+    srv = FtpServer(port=free_port(), filer_url="127.0.0.1:1",
+                    users={"u": "secret"}).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        f = s.makefile("rb")
+        assert f.readline().startswith(b"220")
+        s.sendall(b"USER nobody\r\n")
+        assert f.readline().startswith(b"331")
+        s.sendall(b"PASS secret\r\n")
+        assert f.readline().startswith(b"530")
+        s.sendall(b"USER u\r\nPASS secret\r\n")
+        assert f.readline().startswith(b"331")
+        assert f.readline().startswith(b"230")
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_pooled_retry_only_for_idempotent_requests():
+    """A stale pooled socket (peer closed between requests) is re-dialed
+    for GET / idempotent-flagged POSTs, but a plain POST must surface the
+    error instead of risking double execution (ADVICE r2)."""
+    from seaweedfs_tpu.server import http_util
+
+    served = []
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    port = lsock.getsockname()[1]
+    lsock.listen(8)
+    stop = threading.Event()
+
+    def one_shot_server():
+        # serves exactly ONE request per connection, then closes it —
+        # every pooled reuse hits a dead socket
+        while not stop.is_set():
+            try:
+                lsock.settimeout(0.2)
+                conn, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            data = b""
+            while b"\r\n\r\n" not in data:
+                data += conn.recv(65536)
+            head = data.split(b"\r\n")[0].decode()
+            cl = 0
+            low = data.lower()
+            if b"content-length:" in low:
+                ix = low.index(b"content-length:")
+                cl = int(low[ix + 15: low.index(b"\r\n", ix)])
+            body_have = len(data) - (data.index(b"\r\n\r\n") + 4)
+            while body_have < cl:
+                body_have += len(conn.recv(65536))
+            served.append(head.split()[0])
+            conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok")
+            conn.close()
+
+    t = threading.Thread(target=one_shot_server, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        # GET, then a reused-socket GET: retried transparently
+        assert http_bytes("GET", base + "/a")[0] == 200
+        assert http_bytes("GET", base + "/b")[0] == 200
+        # reused-socket plain POST: must raise, not silently re-send
+        with pytest.raises(Exception):
+            http_bytes("POST", base + "/c", body=b"x")
+        # idempotent-flagged POST on a (now fresh-dialed, then stale) socket
+        assert http_bytes("POST", base + "/d", body=b"x",
+                          idempotent=True)[0] == 200
+        assert http_bytes("POST", base + "/e", body=b"x",
+                          idempotent=True)[0] == 200
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        lsock.close()

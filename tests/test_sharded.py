@@ -8,10 +8,35 @@ from seaweedfs_tpu.ec.codec import NumpyCodec
 
 
 def test_factor_mesh():
-    for n, want in ((1, (1, 1, 1)), (2, (1, 1, 2)), (4, (2, 1, 2)), (8, (2, 2, 2))):
+    # default: tp=1 — columns shard with no collectives so every device
+    # runs the fused kernel at full rate
+    for n, want in ((1, (1, 1, 1)), (2, (2, 1, 1)), (4, (2, 2, 1)), (8, (4, 2, 1))):
         assert sharded.factor_mesh(n) == want
     dp, sp, tp = sharded.factor_mesh(6)
     assert dp * sp * tp == 6
+    # explicit tp: the psum formulation stays available
+    for n, want in ((2, (1, 1, 2)), (4, (2, 1, 2)), (8, (2, 2, 2))):
+        assert sharded.factor_mesh(n, tp=2) == want
+    with pytest.raises(ValueError):
+        sharded.factor_mesh(3, tp=2)
+
+
+def test_mesh_codec_pallas_interpret_composes_with_shard_map():
+    """The fused Pallas kernel as the per-device body under shard_map
+    (interpret mode: no TPU in CI). Bytes must match the numpy oracle."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough devices")
+    mesh = sharded.build_mesh(4)  # (dp=2, sp=2, tp=1)
+    codec = sharded.MeshCodec(
+        mesh=mesh, chunk_bytes=64 * 1024, use_pallas=True, pallas_tile=1024,
+        pallas_interpret=True,
+    )
+    assert codec.use_pallas
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (10, 3 * 4096 + 123), dtype=np.uint8)
+    assert np.array_equal(codec.encode(data), NumpyCodec().encode(data))
 
 
 @pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
