@@ -374,6 +374,30 @@ def main() -> None:
     dev = jax.devices()[0]
     log(f"device: {dev.device_kind} ({dev.platform})")
 
+    # -- small-file data plane (the reference's weed benchmark workload) ------
+    smallfile = None
+    try:
+        r = _run_probe(["--probe-smallfile", "10000", "16"], timeout=300)
+        if r.returncode == 0 and r.stdout.strip():
+            smallfile = json.loads(r.stdout.strip().splitlines()[-1])
+            smallfile["note"] = (
+                "1KB files, c=16, client+servers share this host's core(s); "
+                "reference baseline: 15,708 w/s, 47,019 r/s on a MacBook i7 "
+                "(README.md:504-538)"
+            )
+            log(
+                f"smallfile: write {smallfile['write']['rps']} req/s "
+                f"p50={smallfile['write']['p50_ms']}ms; read "
+                f"{smallfile['read']['rps']} req/s "
+                f"p50={smallfile['read']['p50_ms']}ms (turbo={smallfile['turbo']})"
+            )
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            log(f"smallfile probe failed: {tail[0][:140]}")
+    except subprocess.TimeoutExpired:
+        log("smallfile probe timed out")
+
+
     # -- encode probes in fresh subprocesses ----------------------------------
     best, best_cfg, best_raw = 0.0, None, 0.0
     successes = 0
@@ -465,29 +489,6 @@ def main() -> None:
                 log(f"rebuild-stream chunk={chunk_mb}MB failed: {tail[0][:140]}")
             except subprocess.TimeoutExpired:
                 log(f"rebuild-stream chunk={chunk_mb}MB timed out")
-
-    # -- small-file data plane (the reference's weed benchmark workload) ------
-    smallfile = None
-    try:
-        r = _run_probe(["--probe-smallfile", "10000", "16"], timeout=300)
-        if r.returncode == 0 and r.stdout.strip():
-            smallfile = json.loads(r.stdout.strip().splitlines()[-1])
-            smallfile["note"] = (
-                "1KB files, c=16, client+servers share this host's core(s); "
-                "reference baseline: 15,708 w/s, 47,019 r/s on a MacBook i7 "
-                "(README.md:504-538)"
-            )
-            log(
-                f"smallfile: write {smallfile['write']['rps']} req/s "
-                f"p50={smallfile['write']['p50_ms']}ms; read "
-                f"{smallfile['read']['rps']} req/s "
-                f"p50={smallfile['read']['p50_ms']}ms (turbo={smallfile['turbo']})"
-            )
-        else:
-            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
-            log(f"smallfile probe failed: {tail[0][:140]}")
-    except subprocess.TimeoutExpired:
-        log("smallfile probe timed out")
 
     # -- end-to-end disk→shard-files probe (tunnel-bound on this dev setup) ---
     e2e = None

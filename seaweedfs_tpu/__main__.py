@@ -146,6 +146,39 @@ def cmd_filer(args):
                 dialect=conf.get("sql.dialect", ""),
                 **kwargs,
             )
+        elif conf.get_bool("cassandra.enabled"):
+            from .filer.sdk_stores import CassandraStore
+
+            store = CassandraStore(
+                hosts=[h.strip() for h in str(
+                    conf.get("cassandra.hosts", "127.0.0.1")).split(",")],
+                keyspace=conf.get("cassandra.keyspace", "seaweedfs"),
+                username=conf.get("cassandra.username", ""),
+                password=conf.get("cassandra.password", ""),
+            )
+        elif conf.get_bool("mongodb.enabled"):
+            from .filer.sdk_stores import MongoStore
+
+            store = MongoStore(
+                uri=conf.get("mongodb.uri", "mongodb://127.0.0.1:27017"),
+                database=conf.get("mongodb.database", "seaweedfs"),
+            )
+        elif conf.get_bool("etcd.enabled"):
+            from .filer.sdk_stores import EtcdStore
+
+            store = EtcdStore(
+                endpoint=conf.get("etcd.servers", "127.0.0.1:2379"),
+                prefix=conf.get("etcd.prefix", "seaweedfs."),
+            )
+        elif conf.get_bool("elastic7.enabled"):
+            from .filer.sdk_stores import ElasticStore
+
+            store = ElasticStore(
+                servers=[s.strip() for s in str(
+                    conf.get("elastic7.servers",
+                             "http://127.0.0.1:9200")).split(",")],
+                index=conf.get("elastic7.index", "seaweedfs"),
+            )
         elif conf.get_bool("sqlite.enabled"):
             db_path = conf.get("sqlite.dbFile", "./filer.db")
     fs = FilerServer(

@@ -95,15 +95,18 @@ def write_dat_file(
         with open(base_file_name + ".dat", "wb") as dat:
             remaining = dat_size
 
+            src_sizes = {id(f): os.path.getsize(f.name) for f in inputs}
+
             def copy_n(src, n):
                 from .encoder import _is_hole
 
                 left = n
+                src_size = src_sizes[id(src)]
                 while left > 0:
                     step = min(left, _COPY_CHUNK)
                     pos = src.tell()
-                    if pos + step > os.path.getsize(src.name):
-                        step_avail = os.path.getsize(src.name) - pos
+                    if pos + step > src_size:
+                        step_avail = src_size - pos
                         if step_avail <= 0:
                             raise IOError(
                                 f"shard truncated: wanted {left} more bytes"

@@ -356,8 +356,14 @@ class FuseMount:
 
         @guard
         def op_create(path, mode, fi):
-            h = self.wfs.open(self._fp(path), "w")
-            h.entry.mode = mode & 0o7777
+            p = self._fp(path)
+            h = self.wfs.open(p, "w")
+            if (mode & 0o7777) != h.entry.mode:
+                # WFS.open('w') already committed the entry with the default
+                # mode; persist the kernel-requested one or `touch`-style
+                # empty creates stat with the wrong permissions
+                h.entry.mode = mode & 0o7777
+                self._commit_entry(p, h.entry)
             fi.contents.fh = _register(h)
             return 0
 

@@ -246,13 +246,15 @@ class FileHandle:
         The prefix chunks are UPLOADED BEFORE the entry commit: a failure
         anywhere leaves the old entry (and the data) intact instead of
         committing an emptied chunk list first and losing the file."""
-        new_chunks: list[FileChunk] = []
-        if length > 0:
-            if length >= self.size():
-                return  # logical extension / no-op
-            prefix = self.read(0, length)
-            new_chunks = self.wfs.save_data_as_chunks(prefix, 0)
-        with self._lock:
+        with self._lock:  # RLock: read() below re-enters; holding it across
+            # the whole operation keeps a concurrent acknowledged write from
+            # landing between the prefix snapshot and the commit
+            new_chunks: list[FileChunk] = []
+            if length > 0:
+                if length >= self.size():
+                    return  # logical extension / no-op
+                prefix = self.read(0, length)
+                new_chunks = self.wfs.save_data_as_chunks(prefix, 0)
             self.dirty = ContinuousIntervals()
             self.entry.chunks = new_chunks
             self.wfs.client.create_entry(self.path, self.entry.to_dict())
