@@ -96,6 +96,10 @@ class CassandraStore(FilerStore):
         )
 
     def delete_folder_children(self, path: str) -> None:
+        # direct children only: the partition key admits equality, not
+        # ranges — exactly the reference's behavior (cassandra_store.go
+        # DeleteFolderChildren). Subtree recursion happens in the filer
+        # (filer.py _delete_entry walks directories), so nothing is lost.
         self._s.execute(
             "DELETE FROM filemeta WHERE directory=%s", (_norm(path),)
         )
@@ -166,7 +170,11 @@ class MongoStore(FilerStore):
         self._c.delete_one({"directory": d, "name": n})
 
     def delete_folder_children(self, path: str) -> None:
-        self._c.delete_many({"directory": _norm(path)})
+        import re
+
+        # whole subtree, matching the portable stores' contract
+        p = re.escape(_norm(path))
+        self._c.delete_many({"directory": {"$regex": f"^{p}(/|$)"}})
 
     def list_entries(self, dir_path: str, start_after: str = "",
                      limit: int = 1000) -> Iterator[Entry]:
@@ -227,25 +235,34 @@ class EtcdStore(FilerStore):
         self._c.delete(self._key(path))
 
     def delete_folder_children(self, path: str) -> None:
+        # two prefixes cover the subtree without clipping siblings:
+        # "<dir>\x00" = direct children, "<dir>/" = all nested directories
+        # ("/a" must not match "/ab\x00...")
         self._c.delete_prefix(f"{self._p}{_norm(path)}\x00")
+        self._c.delete_prefix(f"{self._p}{_norm(path)}/")
 
     def list_entries(self, dir_path: str, start_after: str = "",
                      limit: int = 1000) -> Iterator[Entry]:
         count = 0
         prefix = f"{self._p}{_norm(dir_path)}\x00"
+        # server-side range from just past the cursor; `limit` is pushed to
+        # etcd where the client supports it (RangeRequest.limit), so a page
+        # transfers only its own entries — older python-etcd3 falls back to
+        # fetching the range tail and breaking locally
+        kwargs = {"sort_order": "ascend", "sort_target": "key"}
         if start_after:
-            # server-side range from just past the cursor — a page of a
-            # 100k-entry directory must not pull the whole prefix
             import etcd3.utils as _u  # type: ignore
 
-            it = self._c.get_range(
-                prefix + start_after + "\x00",
-                _u.prefix_range_end(_u.to_bytes(prefix)),
-                sort_order="ascend", sort_target="key",
-            )
+            args = (prefix + start_after + "\x00",
+                    _u.prefix_range_end(_u.to_bytes(prefix)))
+            fetch = self._c.get_range
         else:
-            it = self._c.get_prefix(prefix, sort_order="ascend",
-                                    sort_target="key")
+            args = (prefix,)
+            fetch = self._c.get_prefix
+        try:
+            it = fetch(*args, limit=limit, **kwargs)
+        except TypeError:
+            it = fetch(*args, **kwargs)
         for raw, meta in it:
             if count >= limit:
                 break  # keys arrive ascending: nothing more to take
@@ -290,10 +307,16 @@ class ElasticStore(FilerStore):
 
     def insert_entry(self, entry: Entry) -> None:
         d, n = _split(entry.full_path)
+        # refresh=wait_for: the filer's metadata reads are
+        # read-your-writes everywhere else (a directory listing issued
+        # right after a create MUST see the entry — _delete_entry counts
+        # children through list_entries); default async refresh would
+        # make just-written entries invisible for up to a second
         self._c.index(
             index=self._index, id=self._id(entry.full_path),
             body={"directory": d, "name": n,
                   "meta": _ser(entry).decode()},
+            refresh="wait_for",
         )
 
     update_entry = insert_entry
@@ -309,14 +332,19 @@ class ElasticStore(FilerStore):
 
     def delete_entry(self, path: str) -> None:
         try:
-            self._c.delete(index=self._index, id=self._id(path))
+            self._c.delete(index=self._index, id=self._id(path),
+                           refresh="wait_for")
         except self._not_found:
             pass
 
     def delete_folder_children(self, path: str) -> None:
+        p = _norm(path)
         self._c.delete_by_query(
-            index=self._index,
-            body={"query": {"term": {"directory.keyword": _norm(path)}}},
+            index=self._index, refresh=True,
+            body={"query": {"bool": {"should": [
+                {"term": {"directory.keyword": p}},
+                {"prefix": {"directory.keyword": p + "/"}},
+            ], "minimum_should_match": 1}}},
         )
 
     def list_entries(self, dir_path: str, start_after: str = "",
@@ -340,12 +368,12 @@ class ElasticStore(FilerStore):
 
     def kv_put(self, key: bytes, value: bytes) -> None:
         self._c.index(index=self._index + "_kv", id=key.hex(),
-                      body={"value": value.hex()})
+                      body={"value": value.hex()}, refresh="wait_for")
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
         try:
             doc = self._c.get(index=self._index + "_kv", id=key.hex())
-        except Exception:
+        except self._not_found:  # outages propagate; only misses are None
             return None
         return bytes.fromhex(doc["_source"]["value"])
 

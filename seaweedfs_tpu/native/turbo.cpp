@@ -119,6 +119,195 @@ static uint32_t crc_masked(uint32_t crc) {
   return rot + 0xA282EAD8u;
 }
 
+static inline uint32_t be32(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) | ((uint32_t)p[2] << 8) | p[3];
+}
+static inline uint64_t be64(const uint8_t* p) {
+  return ((uint64_t)be32(p) << 32) | be32(p + 4);
+}
+static inline void put_be32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+static inline void put_be64(uint8_t* p, uint64_t v) {
+  put_be32(p, v >> 32);
+  put_be32(p + 4, (uint32_t)v);
+}
+
+// ---------------------------------------------------------------------------
+// SHA-256 + HMAC + base64url: enough crypto to verify the fid-scoped HS256
+// JWTs (security/jwt.py gen_jwt / weed/security/jwt.go GenJwt) natively, so
+// auth-enabled deployments keep the fast path instead of proxying.
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buf_len = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void block(const uint8_t* p) {
+    static const uint32_t K[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = ((uint32_t)p[i * 4] << 24) | ((uint32_t)p[i * 4 + 1] << 16) |
+             ((uint32_t)p[i * 4 + 2] << 8) | p[i * 4 + 3];
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* p, size_t n) {
+    len += n;
+    while (n > 0) {
+      size_t take = std::min(n, (size_t)64 - buf_len);
+      memcpy(buf + buf_len, p, take);
+      buf_len += take;
+      p += take;
+      n -= take;
+      if (buf_len == 64) {
+        block(buf);
+        buf_len = 0;
+      }
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_len != 56) update(&zero, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; i++) lb[i] = (bits >> (56 - 8 * i)) & 0xFF;
+    update(lb, 8);
+    for (int i = 0; i < 8; i++) put_be32(out + 4 * i, h[i]);
+  }
+};
+
+static void hmac_sha256(const std::string& key, const std::string& msg,
+                        uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (key.size() > 64) {
+    Sha256 s;
+    s.update((const uint8_t*)key.data(), key.size());
+    s.final(k);
+  } else {
+    memcpy(k, key.data(), key.size());
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  uint8_t inner[32];
+  Sha256 si;
+  si.update(ipad, 64);
+  si.update((const uint8_t*)msg.data(), msg.size());
+  si.final(inner);
+  Sha256 so;
+  so.update(opad, 64);
+  so.update(inner, 32);
+  so.final(out);
+}
+
+static bool b64url_decode(const std::string& in, std::string* out) {
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '-') return 62;
+    if (c == '_') return 63;
+    return -1;
+  };
+  out->clear();
+  int acc = 0, nbits = 0;
+  for (char c : in) {
+    if (c == '=') break;
+    int v = val(c);
+    if (v < 0) return false;
+    acc = (acc << 6) | v;
+    nbits += 6;
+    if (nbits >= 8) {
+      nbits -= 8;
+      out->push_back((char)((acc >> nbits) & 0xFF));
+    }
+  }
+  return true;
+}
+
+// Verify a compact HS256 JWT scoped to `fid` (security/jwt.py
+// verify_fid_jwt): signature, expiry, and exact fid claim.
+static bool verify_fid_jwt(const std::string& key, const std::string& token,
+                           const std::string& fid) {
+  size_t d1 = token.find('.');
+  if (d1 == std::string::npos) return false;
+  size_t d2 = token.find('.', d1 + 1);
+  if (d2 == std::string::npos) return false;
+  std::string msg = token.substr(0, d2);
+  std::string sig;
+  if (!b64url_decode(token.substr(d2 + 1), &sig) || sig.size() != 32)
+    return false;
+  uint8_t want[32];
+  hmac_sha256(key, msg, want);
+  uint8_t diff = 0;
+  for (int i = 0; i < 32; i++) diff |= want[i] ^ (uint8_t)sig[i];
+  if (diff) return false;
+  std::string payload;
+  if (!b64url_decode(token.substr(d1 + 1, d2 - d1 - 1), &payload))
+    return false;
+  // claims are our own compact json: {"exp":N,"fid":"..."}
+  size_t ep = payload.find("\"exp\":");
+  if (ep == std::string::npos) return false;
+  long long exp = strtoll(payload.c_str() + ep + 6, nullptr, 10);
+  if (exp < (long long)time(nullptr)) return false;
+  size_t fp = payload.find("\"fid\":\"");
+  if (fp == std::string::npos) return false;
+  size_t fs = fp + 7;
+  size_t fe = payload.find('"', fs);
+  if (fe == std::string::npos) return false;
+  std::string claim = payload.substr(fs, fe - fs);
+  for (auto& ch : claim)
+    if (ch == '/') ch = ',';  // normalize vid/key vs vid,key
+  return claim == fid;
+}
+
 // ---------------------------------------------------------------------------
 // Needle/idx format constants (storage/types.py, storage/needle.py).
 
@@ -135,20 +324,6 @@ static const uint8_t FLAG_HAS_LAST_MODIFIED = 0x08;
 static const uint8_t FLAG_HAS_TTL = 0x10;
 static const uint8_t FLAG_HAS_PAIRS = 0x20;
 static const uint8_t FLAG_IS_CHUNK_MANIFEST = 0x80;
-
-static inline uint32_t be32(const uint8_t* p) {
-  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) | ((uint32_t)p[2] << 8) | p[3];
-}
-static inline uint64_t be64(const uint8_t* p) {
-  return ((uint64_t)be32(p) << 32) | be32(p + 4);
-}
-static inline void put_be32(uint8_t* p, uint32_t v) {
-  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
-}
-static inline void put_be64(uint8_t* p, uint64_t v) {
-  put_be32(p, v >> 32);
-  put_be32(p + 4, (uint32_t)v);
-}
 
 // padding after the record — always 1..8 (needle_read_write.go:298-304)
 static int padding_len(int64_t needle_size, int version) {
@@ -327,6 +502,8 @@ struct Engine {
   int backend_port = 0;
   std::string bind_ip;
   int port = 0;
+  // fid-scoped JWT keys (set before workers serve traffic; empty = open)
+  std::string jwt_write_key, jwt_read_key;
 
   std::vector<std::thread> workers;
   std::vector<int> stop_fds;  // eventfd per worker
@@ -493,7 +670,7 @@ struct Req {
   int64_t content_length = 0;
   bool conn_close = false;
   bool has_te_chunked = false;
-  std::string range, name, mime, content_encoding;
+  std::string range, name, mime, content_encoding, bearer;
   bool chunk_manifest = false;
   size_t total_len;     // header + body length in the buffer
   const uint8_t* body;
@@ -583,6 +760,9 @@ static int parse_request(const std::string& buf, Req* r) {
         r->chunk_manifest = (v == "true");
       else if (ieq(k, klen, "content-encoding"))
         r->content_encoding = v;
+      else if (ieq(k, klen, "authorization")) {
+        if (v.compare(0, 7, "Bearer ") == 0) r->bearer = v.substr(7);
+      }
     }
     i = lend + 2;
   }
@@ -601,6 +781,7 @@ struct Fid {
   uint32_t vid;
   uint64_t key;
   uint32_t cookie;
+  std::string str;  // "vid,hex[_delta]" — the JWT claim form (_auth_ok)
 };
 
 static bool parse_fid_path(const std::string& path, Fid* f) {
@@ -619,6 +800,9 @@ static bool parse_fid_path(const std::string& path, Fid* f) {
   // strip extension (volume server strips from rindex('.'))
   size_t dot = fid.rfind('.');
   if (dot != std::string::npos) fid = fid.substr(0, dot);
+  // JWT claim form BEFORE the delta split (volume_server._auth_ok builds
+  // "vid,hex[_delta]" the same way — ext stripped, first sep → comma)
+  f->str = std::to_string(vid) + "," + fid;
   // _delta suffix (chunked uploads, needle.go:120-142)
   uint64_t delta = 0;
   size_t us = fid.rfind('_');
@@ -637,6 +821,16 @@ static bool parse_fid_path(const std::string& path, Fid* f) {
   f->key = strtoull(fid.substr(0, split).c_str(), nullptr, 16) + delta;
   f->cookie = (uint32_t)strtoul(fid.substr(split).c_str(), nullptr, 16);
   return true;
+}
+
+// fid-scoped auth gate (volume_server._auth_ok): query `auth` wins, then
+// the Bearer header; empty key = open.
+static bool auth_ok(const std::string& key, const Req& r, const Fid& f) {
+  if (key.empty()) return true;
+  std::string token = q_get(r.query, "auth");
+  if (token.size() == 1 && token[0] == '\x01') token.clear();  // absent
+  if (token.empty()) token = r.bearer;
+  return verify_fid_jwt(key, token, f.str);
 }
 
 static std::string hexkey(uint64_t key) {
@@ -868,6 +1062,9 @@ static int handle_get(Worker* w, Conn* c, const Req& r, const Fid& f,
   Engine* e = w->eng;
   auto vol = e->get_vol(f.vid);
   if (!vol || vol->dead.load()) return 1;
+  if (!auth_ok(e->jwt_read_key, r, f))
+    return reply_json(w, c, 401, "{\"error\": \"unauthorized read\"}",
+                      head_only) ? 0 : -1;
   if (q_has(r.query, "width") || q_has(r.query, "height") || q_has(r.query, "cm"))
     return 1;  // image resize / manifest-control paths stay in Python
 
@@ -955,6 +1152,9 @@ static int handle_post(Worker* w, Conn* c, const Req& r, const Fid& f) {
   Engine* e = w->eng;
   auto vol = e->get_vol(f.vid);
   if (!vol || vol->dead.load()) return 1;
+  if (!auth_ok(e->jwt_write_key, r, f))
+    return reply_json(w, c, 401, "{\"error\": \"unauthorized write\"}")
+               ? 0 : -1;
   if (!vol->writable_http || vol->version != 3) return 1;  // replication/old fmt
   if (q_has(r.query, "ttl")) return 1;  // needle-level TTL writes stay in Python
   if (vol->read_only.load())
@@ -1100,6 +1300,9 @@ static int handle_delete(Worker* w, Conn* c, const Req& r, const Fid& f) {
   Engine* e = w->eng;
   auto vol = e->get_vol(f.vid);
   if (!vol || vol->dead.load()) return 1;
+  if (!auth_ok(e->jwt_write_key, r, f))
+    return reply_json(w, c, 401, "{\"error\": \"unauthorized delete\"}")
+               ? 0 : -1;
   if (!vol->writable_http || vol->version != 3) return 1;
   if (vol->read_only.load())
     return reply_json(w, c, 500,
@@ -1452,6 +1655,17 @@ long long turbo_start(const char* bind_ip, int port, const char* backend_ip,
     });
   }
   return (long long)(intptr_t)e;
+}
+
+// Install fid-JWT keys. Call BEFORE volumes are registered (keys are read
+// without locks on the hot path; the engine serves only proxied traffic
+// until registration anyway).
+void turbo_set_jwt(long long handle, const char* write_key,
+                   const char* read_key) {
+  Engine* e = (Engine*)(intptr_t)handle;
+  if (!e) return;
+  e->jwt_write_key = write_key ? write_key : "";
+  e->jwt_read_key = read_key ? read_key : "";
 }
 
 void turbo_stop(long long handle) {

@@ -50,6 +50,9 @@ def _load():
             ctypes.c_int,
         ]
         lib.turbo_stop.argtypes = [ctypes.c_longlong]
+        lib.turbo_set_jwt.argtypes = [
+            ctypes.c_longlong, ctypes.c_char_p, ctypes.c_char_p,
+        ]
         lib.turbo_register.restype = ctypes.c_int
         lib.turbo_register.argtypes = [
             ctypes.c_longlong, ctypes.c_uint, ctypes.c_char_p, ctypes.c_char_p,
@@ -115,6 +118,12 @@ class TurboEngine:
             raise RuntimeError(f"turbo_start failed to bind {bind_ip}:{port}")
         self.port = port
         self.threads = threads
+
+    def set_jwt_keys(self, write_key: str, read_key: str) -> None:
+        """Install fid-JWT keys for native verification (call before any
+        volume is attached; security/jwt.py semantics)."""
+        self._lib.turbo_set_jwt(self._h, write_key.encode(),
+                                read_key.encode())
 
     def stop(self) -> None:
         if self._h:

@@ -1159,9 +1159,7 @@ class VolumeServer:
         self.turbo = None
         use_turbo = (
             os.environ.get("SWEED_TURBO", "1") != "0"
-            and not self.jwt_signing_key
-            and not self.jwt_read_key
-            and self.guard.allow_all
+            and self.guard.allow_all  # IP whitelists stay in Python
         )
         if use_turbo:
             internal = None
@@ -1174,6 +1172,12 @@ class VolumeServer:
                     self.turbo = TurboEngine(
                         self.host, self.port, "127.0.0.1", iport
                     )
+                    if self.jwt_signing_key or self.jwt_read_key:
+                        # fid-JWTs verified natively (HMAC-SHA256 in the
+                        # engine) so auth keeps the fast path
+                        self.turbo.set_jwt_keys(
+                            self.jwt_signing_key, self.jwt_read_key
+                        )
                     self._srv = internal
                     self.store.turbo_engine = self.turbo
                     self.store.attach_turbo_all()
