@@ -172,9 +172,14 @@ class MongoStore(FilerStore):
     def delete_folder_children(self, path: str) -> None:
         import re
 
-        # whole subtree, matching the portable stores' contract
-        p = re.escape(_norm(path))
-        self._c.delete_many({"directory": {"$regex": f"^{p}(/|$)"}})
+        # whole subtree, matching the portable stores' contract; root's
+        # nested matcher must be "/" not "//" (abstract_sql rstrip parity)
+        p = _norm(path)
+        nested = (p.rstrip("/") + "/")
+        self._c.delete_many({"$or": [
+            {"directory": p},
+            {"directory": {"$regex": "^" + re.escape(nested)}},
+        ]})
 
     def list_entries(self, dir_path: str, start_after: str = "",
                      limit: int = 1000) -> Iterator[Entry]:
@@ -236,10 +241,12 @@ class EtcdStore(FilerStore):
 
     def delete_folder_children(self, path: str) -> None:
         # two prefixes cover the subtree without clipping siblings:
-        # "<dir>\x00" = direct children, "<dir>/" = all nested directories
-        # ("/a" must not match "/ab\x00...")
-        self._c.delete_prefix(f"{self._p}{_norm(path)}\x00")
-        self._c.delete_prefix(f"{self._p}{_norm(path)}/")
+        # "<dir>\x00" = direct children, "<dir rstripped>/" = all nested
+        # directories ("/a" must not match "/ab\x00..."; root's nested
+        # prefix is "/", not "//")
+        p = _norm(path)
+        self._c.delete_prefix(f"{self._p}{p}\x00")
+        self._c.delete_prefix(f"{self._p}{p.rstrip('/')}/")
 
     def list_entries(self, dir_path: str, start_after: str = "",
                      limit: int = 1000) -> Iterator[Entry]:
@@ -343,7 +350,7 @@ class ElasticStore(FilerStore):
             index=self._index, refresh=True,
             body={"query": {"bool": {"should": [
                 {"term": {"directory.keyword": p}},
-                {"prefix": {"directory.keyword": p + "/"}},
+                {"prefix": {"directory.keyword": p.rstrip("/") + "/"}},
             ], "minimum_should_match": 1}}},
         )
 
@@ -367,8 +374,10 @@ class ElasticStore(FilerStore):
             yield _deser(f"{dir_path}/{src['name']}", src["meta"].encode())
 
     def kv_put(self, key: bytes, value: bytes) -> None:
+        # no refresh: kv_get fetches by document id, which is realtime in
+        # ES — waiting for an index refresh would only add write latency
         self._c.index(index=self._index + "_kv", id=key.hex(),
-                      body={"value": value.hex()}, refresh="wait_for")
+                      body={"value": value.hex()})
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
         try:

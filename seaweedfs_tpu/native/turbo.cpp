@@ -52,6 +52,7 @@
 #include <sys/uio.h>
 #include <time.h>
 #include <unistd.h>
+#include <zlib.h>
 
 // ---------------------------------------------------------------------------
 // CRC-32C (Castagnoli), matching storage/crc.py / weed/storage/needle/crc.go.
@@ -671,6 +672,7 @@ struct Req {
   bool conn_close = false;
   bool has_te_chunked = false;
   std::string range, name, mime, content_encoding, bearer;
+  bool accepts_gzip = false;
   bool chunk_manifest = false;
   size_t total_len;     // header + body length in the buffer
   const uint8_t* body;
@@ -762,6 +764,8 @@ static int parse_request(const std::string& buf, Req* r) {
         r->content_encoding = v;
       else if (ieq(k, klen, "authorization")) {
         if (v.compare(0, 7, "Bearer ") == 0) r->bearer = v.substr(7);
+      } else if (ieq(k, klen, "accept-encoding")) {
+        if (v.find("gzip") != std::string::npos) r->accepts_gzip = true;
       }
     }
     i = lend + 2;
@@ -1108,14 +1112,49 @@ static int handle_get(Worker* w, Conn* c, const Req& r, const Fid& f,
   if (!p.ok)
     return reply_json(w, c, 500, "{\"error\": \"corrupt needle body\"}",
                       head_only) ? 0 : -1;
-  if (p.flags & (FLAG_IS_COMPRESSED | FLAG_IS_CHUNK_MANIFEST))
-    return 1;  // gzip negotiation / manifest resolution live in Python
-  // CRC (read_needle verifies on every read)
+  if (p.flags & FLAG_IS_CHUNK_MANIFEST)
+    return 1;  // manifest resolution (cross-needle assembly) lives in Python
+  // CRC (read_needle verifies on every read; covers the stored bytes)
   uint32_t stored = be32(rec.data() + NEEDLE_HEADER + size);
   if (stored != crc_masked(crc32c(p.data, p.data_len)))
     return reply_json(w, c, 500,
                       "{\"error\": \"CrcError: CRC error! data on disk corrupted\"}",
                       head_only) ? 0 : -1;
+  // gzip'd needles (volume_server.py _h_get:176-188): clients that accept
+  // gzip get the stored bytes verbatim + Content-Encoding (ranges are then
+  // NOT applied — they would address the plaintext); everyone else gets an
+  // inflate right here instead of a proxy hop to Python
+  std::string inflated;
+  bool serving_gzip = false;
+  if (p.flags & FLAG_IS_COMPRESSED) {
+    if (r.accepts_gzip) {
+      serving_gzip = true;
+    } else {
+      z_stream zs{};
+      if (inflateInit2(&zs, 15 + 32) != Z_OK)  // gzip or zlib wrapper
+        return reply_json(w, c, 500, "{\"error\": \"inflate init failed\"}",
+                          head_only) ? 0 : -1;
+      inflated.resize(std::max<int64_t>(p.data_len * 4, 4096));
+      zs.next_in = (Bytef*)p.data;
+      zs.avail_in = (uInt)p.data_len;
+      int ret;
+      size_t out_len = 0;
+      do {
+        if (out_len == inflated.size()) inflated.resize(inflated.size() * 2);
+        zs.next_out = (Bytef*)inflated.data() + out_len;
+        zs.avail_out = (uInt)(inflated.size() - out_len);
+        ret = inflate(&zs, Z_NO_FLUSH);
+        out_len = inflated.size() - zs.avail_out;
+      } while (ret == Z_OK);
+      inflateEnd(&zs);
+      if (ret != Z_STREAM_END)
+        return reply_json(w, c, 500, "{\"error\": \"corrupt gzip needle\"}",
+                          head_only) ? 0 : -1;
+      inflated.resize(out_len);
+      p.data = (const uint8_t*)inflated.data();
+      p.data_len = (int64_t)inflated.size();
+    }
+  }
   // TTL expiry (volume.py read_needle:414-424)
   if ((p.flags & FLAG_HAS_TTL) && (p.flags & FLAG_HAS_LAST_MODIFIED)) {
     int64_t mins = ttl_minutes(p.ttl_count, p.ttl_unit);
@@ -1124,6 +1163,10 @@ static int handle_get(Worker* w, Conn* c, const Req& r, const Fid& f,
                         "{\"error\": \"needle " + hexkey(f.key) + " expired\"}",
                         head_only) ? 0 : -1;
   }
+  if (serving_gzip)
+    return reply(w, c, 200, "application/octet-stream",
+                 "Content-Encoding: gzip\r\nAccept-Ranges: bytes\r\n",
+                 (const char*)p.data, p.data_len, head_only) ? 0 : -1;
   if (!r.range.empty()) {
     int64_t st = 0, en = 0;
     int kind = parse_range(r.range, p.data_len, &st, &en);
