@@ -1120,6 +1120,15 @@ static int handle_get(Worker* w, Conn* c, const Req& r, const Fid& f,
     return reply_json(w, c, 500,
                       "{\"error\": \"CrcError: CRC error! data on disk corrupted\"}",
                       head_only) ? 0 : -1;
+  // TTL expiry (volume.py read_needle:414-424) — checked BEFORE any
+  // decompression work: an expired needle must cost nothing but a 404
+  if ((p.flags & FLAG_HAS_TTL) && (p.flags & FLAG_HAS_LAST_MODIFIED)) {
+    int64_t mins = ttl_minutes(p.ttl_count, p.ttl_unit);
+    if (mins > 0 && (int64_t)time(nullptr) >= (int64_t)p.last_modified + mins * 60)
+      return reply_json(w, c, 404,
+                        "{\"error\": \"needle " + hexkey(f.key) + " expired\"}",
+                        head_only) ? 0 : -1;
+  }
   // gzip'd needles (volume_server.py _h_get:176-188): clients that accept
   // gzip get the stored bytes verbatim + Content-Encoding (ranges are then
   // NOT applied — they would address the plaintext); everyone else gets an
@@ -1130,38 +1139,53 @@ static int handle_get(Worker* w, Conn* c, const Req& r, const Fid& f,
     if (r.accepts_gzip) {
       serving_gzip = true;
     } else {
+      // bounded + exception-safe: a gzip bomb must 500 this request, not
+      // bad_alloc-terminate the process; multi-member streams (legal per
+      // RFC 1952, decoded fully by Python's gzip.decompress) reset and
+      // continue until the input is consumed
+      const size_t MAX_PLAIN = (size_t)1 << 30;
       z_stream zs{};
       if (inflateInit2(&zs, 15 + 32) != Z_OK)  // gzip or zlib wrapper
         return reply_json(w, c, 500, "{\"error\": \"inflate init failed\"}",
                           head_only) ? 0 : -1;
-      inflated.resize(std::max<int64_t>(p.data_len * 4, 4096));
       zs.next_in = (Bytef*)p.data;
       zs.avail_in = (uInt)p.data_len;
-      int ret;
       size_t out_len = 0;
-      do {
-        if (out_len == inflated.size()) inflated.resize(inflated.size() * 2);
-        zs.next_out = (Bytef*)inflated.data() + out_len;
-        zs.avail_out = (uInt)(inflated.size() - out_len);
-        ret = inflate(&zs, Z_NO_FLUSH);
-        out_len = inflated.size() - zs.avail_out;
-      } while (ret == Z_OK);
+      bool bad = false, too_big = false;
+      try {
+        inflated.resize(std::min<size_t>(
+            MAX_PLAIN, std::max<size_t>((size_t)p.data_len * 4, 4096)));
+        while (true) {
+          if (out_len == inflated.size()) {
+            if (inflated.size() >= MAX_PLAIN) { too_big = true; break; }
+            inflated.resize(std::min(MAX_PLAIN, inflated.size() * 2));
+          }
+          zs.next_out = (Bytef*)inflated.data() + out_len;
+          zs.avail_out = (uInt)(inflated.size() - out_len);
+          int ret = inflate(&zs, Z_NO_FLUSH);
+          out_len = inflated.size() - zs.avail_out;
+          if (ret == Z_STREAM_END) {
+            if (zs.avail_in == 0) break;       // fully consumed
+            if (inflateReset2(&zs, 15 + 32) != Z_OK) { bad = true; break; }
+            continue;                           // next gzip member
+          }
+          if (ret != Z_OK) { bad = true; break; }
+        }
+      } catch (const std::exception&) {
+        bad = true;  // length_error / bad_alloc from resize
+      }
       inflateEnd(&zs);
-      if (ret != Z_STREAM_END)
+      if (too_big)
+        return reply_json(w, c, 500,
+                          "{\"error\": \"decompressed needle too large\"}",
+                          head_only) ? 0 : -1;
+      if (bad)
         return reply_json(w, c, 500, "{\"error\": \"corrupt gzip needle\"}",
                           head_only) ? 0 : -1;
       inflated.resize(out_len);
       p.data = (const uint8_t*)inflated.data();
       p.data_len = (int64_t)inflated.size();
     }
-  }
-  // TTL expiry (volume.py read_needle:414-424)
-  if ((p.flags & FLAG_HAS_TTL) && (p.flags & FLAG_HAS_LAST_MODIFIED)) {
-    int64_t mins = ttl_minutes(p.ttl_count, p.ttl_unit);
-    if (mins > 0 && (int64_t)time(nullptr) >= (int64_t)p.last_modified + mins * 60)
-      return reply_json(w, c, 404,
-                        "{\"error\": \"needle " + hexkey(f.key) + " expired\"}",
-                        head_only) ? 0 : -1;
   }
   if (serving_gzip)
     return reply(w, c, 200, "application/octet-stream",

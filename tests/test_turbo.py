@@ -354,3 +354,21 @@ def test_compressed_needle_served_natively(cluster):
     after = vs.turbo.counters()
     assert after["gets"] >= before["gets"] + 2, (before, after)
     assert after["proxied"] == before["proxied"], "must not proxy"
+
+
+def test_multi_member_gzip_needle_inflates_fully(cluster):
+    """RFC 1952 allows concatenated gzip members; native inflate must decode
+    ALL of them like Python's gzip.decompress — not stop after the first."""
+    import gzip as _gz
+
+    ms, vs = cluster
+    a = operation.assign(ms.url)
+    part1, part2 = b"first-member " * 40, b"second-member " * 40
+    blob = _gz.compress(part1) + _gz.compress(part2)
+    st, _ = http_bytes(
+        "POST", f"http://{a.url}/{a.fid}", body=blob,
+        headers={"Content-Encoding": "gzip"},
+    )
+    assert st == 201
+    st, body = http_bytes("GET", f"http://{a.url}/{a.fid}")
+    assert st == 200 and body == part1 + part2, (st, len(body))
