@@ -394,9 +394,14 @@ class _BenchPump:
 
 def run_benchmark(master: str, n: int, c: int, size: int,
                   collection: str = "benchmark",
-                  assign_batch: int = 100) -> dict:
+                  assign_batch: int = 100,
+                  delete_percent: int = 0) -> dict:
     """Write-then-read load run; returns the raw stats for both phases.
-    Shared by `weed benchmark` (below) and bench.py's small-file probe."""
+    Shared by `weed benchmark` (below) and bench.py's small-file probe.
+    delete_percent mirrors the reference's -deletePercent: that fraction of
+    written files is deleted (timed) before the read phase, and the reads
+    then expect 404s for the deleted fids."""
+    import random as _random
     import secrets
 
     from . import operation
@@ -422,13 +427,33 @@ def run_benchmark(master: str, n: int, c: int, size: int,
     wpump = _BenchPump(c)
     wwall = wpump.run(write_jobs())
 
+    out = {
+        "write": {"wall": wwall, "latencies": wpump.latencies,
+                  "failures": wpump.failures},
+    }
+
+    rng = _random.Random(42)
+    deleted: set[str] = set()
+    if delete_percent > 0:
+        victims = [f for f in fids if rng.randrange(100) < delete_percent]
+        deleted = {f for f, _ in victims}
+
+        def delete_jobs():
+            for fid, url in victims:
+                req = f"DELETE /{fid} HTTP/1.1\r\nHost: {url}\r\n\r\n".encode()
+                yield url, req
+
+        dpump = _BenchPump(c)
+        dwall = dpump.run(delete_jobs())
+        out["delete"] = {"wall": dwall, "latencies": dpump.latencies,
+                         "failures": dpump.failures}
+
     lookup_cache: dict[int, str] = {}
 
     def read_jobs():
-        import random
-
-        random.shuffle(fids)
-        for fid, url in fids:
+        live = [(f, u) for f, u in fids if f not in deleted]
+        rng.shuffle(live)
+        for fid, url in live:
             vid = int(fid.split(",")[0])
             addr = lookup_cache.get(vid)
             if addr is None:
@@ -440,12 +465,9 @@ def run_benchmark(master: str, n: int, c: int, size: int,
 
     rpump = _BenchPump(c)
     rwall = rpump.run(read_jobs())
-    return {
-        "write": {"wall": wwall, "latencies": wpump.latencies,
-                  "failures": wpump.failures},
-        "read": {"wall": rwall, "latencies": rpump.latencies,
-                 "failures": rpump.failures},
-    }
+    out["read"] = {"wall": rwall, "latencies": rpump.latencies,
+                   "failures": rpump.failures}
+    return out
 
 
 def cmd_benchmark(args):
@@ -460,10 +482,14 @@ def cmd_benchmark(args):
     print(f"writing {args.n} files of {args.size}B with concurrency {args.c} "
           f"(assign batch {batch}) ...")
     stats = run_benchmark(args.master, args.n, args.c, args.size,
-                          args.collection, batch)
+                          args.collection, batch,
+                          delete_percent=args.delete_percent)
     _report("write", args, stats["write"]["latencies"], stats["write"]["wall"],
             stats["write"]["failures"])
-    print(f"reading {args.n} files ...")
+    if "delete" in stats:
+        _report("delete", args, stats["delete"]["latencies"],
+                stats["delete"]["wall"], stats["delete"]["failures"])
+    print("reading surviving files ...")
     _report("read", args, stats["read"]["latencies"], stats["read"]["wall"],
             stats["read"]["failures"])
 
@@ -969,6 +995,9 @@ def main(argv=None):
     b.add_argument("-collection", default="benchmark")
     b.add_argument("-assign.batch", dest="assign_batch", type=int, default=100,
                    help="fids reserved per /dir/assign call (1 = per-file)")
+    b.add_argument("-deletePercent", dest="delete_percent", type=int,
+                   default=0, help="percent of written files to delete "
+                   "(timed) before the read phase")
     b.set_defaults(fn=cmd_benchmark)
 
     bk = sub.add_parser("backup", help="incremental local volume backup")

@@ -996,7 +996,21 @@ class VolumeServer:
         return execute_request(data, req)
 
     def _h_metrics(self, h, path, q, body):
-        return 200, self.metrics.expose().encode()
+        out = self.metrics.expose()
+        if self.turbo is not None:
+            # the native engine serves the hot ops without touching the
+            # Python counters; expose its tallies alongside
+            c = self.turbo.counters()
+            out += (
+                "# HELP volume_server_turbo_requests_total requests served "
+                "by the native data plane\n"
+                "# TYPE volume_server_turbo_requests_total counter\n"
+                f'volume_server_turbo_requests_total{{op="get"}} {c["gets"]}\n'
+                f'volume_server_turbo_requests_total{{op="post"}} {c["posts"]}\n'
+                f'volume_server_turbo_requests_total{{op="delete"}} {c["deletes"]}\n'
+                f'volume_server_turbo_requests_total{{op="proxied"}} {c["proxied"]}\n'
+            )
+        return 200, out.encode()
 
     def _h_status(self, h, path, q, body):
         hb = self.store.collect_heartbeat()

@@ -372,3 +372,14 @@ def test_multi_member_gzip_needle_inflates_fully(cluster):
     assert st == 201
     st, body = http_bytes("GET", f"http://{a.url}/{a.fid}")
     assert st == 200 and body == part1 + part2, (st, len(body))
+
+
+def test_metrics_expose_native_counters(cluster):
+    ms, vs = cluster
+    fid = operation.submit(ms.url, secrets.token_bytes(64))
+    operation.download(ms.url, fid)
+    st, body = http_bytes("GET", f"http://{vs.host}:{vs.port}/metrics")
+    assert st == 200
+    text = body.decode()
+    assert 'volume_server_turbo_requests_total{op="get"}' in text
+    assert 'volume_server_turbo_requests_total{op="post"}' in text
