@@ -193,6 +193,7 @@ def cmd_filer(args):
         peers=[p for p in args.peers.split(",") if p],
         meta_log_dir=args.meta_log_dir,
         jwt_signing_key=_security_conf()["jwt_signing_key"],
+        jwt_read_key=_security_conf()["jwt_read_key"],
         store=store,
     ).start()
     # notification.toml → publish meta events to the configured queue
@@ -227,7 +228,10 @@ def cmd_upload(args):
 def cmd_download(args):
     from . import operation
 
-    data = operation.download(args.master, args.fid)
+    data = operation.download(
+        args.master, args.fid,
+        jwt_read_key=_security_conf()["jwt_read_key"],
+    )
     if args.output == "-":
         sys.stdout.buffer.write(data)
     else:

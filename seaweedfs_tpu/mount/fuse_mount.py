@@ -194,7 +194,17 @@ def _find_libfuse() -> Optional[str]:
 
 
 def fuse_available() -> bool:
-    return _find_libfuse() is not None and os.path.exists("/dev/fuse")
+    # the Stat/FuseFileInfo ctypes layouts below encode the x86_64 Linux
+    # ABI; on other arches (aarch64 reorders struct stat fields) a mount
+    # would come up and then feed the kernel garbage metadata — fall back
+    # to the sync daemon there instead
+    import platform
+
+    return (
+        platform.machine() == "x86_64"
+        and _find_libfuse() is not None
+        and os.path.exists("/dev/fuse")
+    )
 
 
 class FuseMount:

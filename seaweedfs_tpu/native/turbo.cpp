@@ -771,7 +771,12 @@ static int parse_request(const std::string& buf, Req* r) {
     i = lend + 2;
   }
   if (r->has_te_chunked) return -1;  // CL-framed only (411 upstream)
-  if (r->content_length < 0 || r->content_length > (int64_t)1 << 31) return -1;
+  // Reject oversize bodies at header-parse time, BEFORE the read loop
+  // buffers them: needles are bounded at 1 GiB (handle_post's 413) and no
+  // inbound endpoint takes more (volume copy is pull-based), so anything
+  // past 1 GiB + multipart/header slack can only be a memory-bloat attack.
+  static const int64_t MAX_BODY = ((int64_t)1 << 30) + (16 << 20);
+  if (r->content_length < 0 || r->content_length > MAX_BODY) return -1;
   if (buf.size() < r->header_end + (size_t)r->content_length) return 0;
   r->total_len = r->header_end + (size_t)r->content_length;
   r->body = (const uint8_t*)buf.data() + r->header_end;
@@ -821,8 +826,15 @@ static bool parse_fid_path(const std::string& path, Fid* f) {
   for (char ch : fid)
     if (!isxdigit((unsigned char)ch)) return false;
   size_t split = fid.size() - 8;
+  uint64_t base = strtoull(fid.substr(0, split).c_str(), nullptr, 16);
+  if (delta > ~0ULL - base) return false;  // key+delta would wrap
+  uint64_t key = base + delta;
+  // ~0ULL is the needle map's EMPTY_KEY slot sentinel; a record stored under
+  // it would vanish on the next table grow. Fall through to the Python proxy,
+  // whose dict-backed map has no sentinel.
+  if (key == EMPTY_KEY) return false;
   f->vid = (uint32_t)vid;
-  f->key = strtoull(fid.substr(0, split).c_str(), nullptr, 16) + delta;
+  f->key = key;
   f->cookie = (uint32_t)strtoul(fid.substr(split).c_str(), nullptr, 16);
   return true;
 }
@@ -1598,11 +1610,23 @@ static void worker_loop(Worker* w) {
         }
       }
       if (!drop && (evs[i].events & EPOLLIN)) {
+        size_t pass_start = c->in.size();
         while (true) {
           ssize_t rn = recv(fd, rbuf, sizeof(rbuf), 0);
           if (rn > 0) {
             c->in.append(rbuf, rn);
-            if (c->in.size() > ((size_t)1 << 31)) { drop = true; break; }
+            // backstop matching parse_request's MAX_BODY: body cap + header
+            // slack; a conn can never legitimately buffer more than this
+            if (c->in.size() > ((size_t)1 << 30) + (17 << 20)) {
+              drop = true;
+              break;
+            }
+            // read at most 4 MB per pass so process_requests gets to
+            // reject bogus framing (oversize Content-Length, unterminated
+            // headers) early — a fast sender must not be able to keep this
+            // loop spinning until the gigabyte backstop; level-triggered
+            // epoll re-fires for the rest
+            if (c->in.size() - pass_start > (4u << 20)) break;
             continue;
           }
           if (rn == 0) {
@@ -1851,6 +1875,7 @@ int turbo_append(long long handle, unsigned vid, unsigned long long key,
                  int size_field, int is_delete, unsigned long long* out_off) {
   Engine* e = (Engine*)(intptr_t)handle;
   if (!e) return -1;
+  if (key == EMPTY_KEY) return -5;  // needle-map slot sentinel; unstorable
   auto v = e->get_vol(vid);
   if (!v) return -2;
   std::lock_guard<std::mutex> lk(v->mu);

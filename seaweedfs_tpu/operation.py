@@ -122,14 +122,17 @@ def lookup(master: str, vid: int) -> list[dict]:
     return r.get("locations", [])
 
 
-def download(master: str, fid: str) -> bytes:
+def download(master: str, fid: str, jwt_read_key: str = "") -> bytes:
     file_id = FileId.parse(fid)
     locs = lookup(master, file_id.volume_id)
     if not locs:
         raise RuntimeError(f"volume {file_id.volume_id} not found")
+    from .security import read_auth_query
+
+    auth = read_auth_query(jwt_read_key, fid)
     last_err = None
     for loc in locs:
-        status, data = http_bytes("GET", f"http://{loc['url']}/{fid}")
+        status, data = http_bytes("GET", f"http://{loc['url']}/{fid}{auth}")
         if status == 200:
             return data
         last_err = f"{loc['url']}: {status}"

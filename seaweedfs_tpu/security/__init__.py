@@ -7,5 +7,5 @@ Keys are shared secrets (HS256), distributed via config — mirroring
 `security.toml` [jwt.signing] / [jwt.signing.read].
 """
 
-from .jwt import decode_jwt, gen_jwt, verify_fid_jwt  # noqa: F401
+from .jwt import decode_jwt, gen_jwt, read_auth_query, verify_fid_jwt  # noqa: F401
 from .guard import Guard  # noqa: F401

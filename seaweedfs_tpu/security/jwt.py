@@ -66,3 +66,11 @@ def verify_fid_jwt(signing_key: str, token: str, fid: str) -> bool:
         return False
     # normalize "vid,key_cookie" vs "vid/key_cookie"
     return claims.get("fid", "").replace("/", ",") == fid.replace("/", ",")
+
+
+def read_auth_query(signing_key: str, fid: str) -> str:
+    """'?auth=<token>' query suffix for a fid-scoped read, or '' when the
+    deployment runs open — the one spelling every read client shares."""
+    if not signing_key:
+        return ""
+    return "?auth=" + gen_jwt(signing_key, fid)

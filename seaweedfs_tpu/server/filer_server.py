@@ -70,6 +70,7 @@ class FilerServer:
         collection: str = "",
         replication: str = "",
         jwt_signing_key: str = "",
+        jwt_read_key: str = "",
         chunk_cache_dir: str = "",
         chunk_cache_mem_mb: int = 64,
         cipher: bool = False,
@@ -82,6 +83,9 @@ class FilerServer:
         from ..util.chunk_cache import TieredChunkCache
 
         self.jwt_signing_key = jwt_signing_key
+        # volume read gate key (security.toml jwt.signing.read.key — shared
+        # with the volume servers, as the reference's filer shares it)
+        self.jwt_read_key = jwt_read_key
         self.chunk_cache = TieredChunkCache(
             directory=chunk_cache_dir or None,
             mem_budget=chunk_cache_mem_mb * 1024 * 1024,
@@ -280,6 +284,13 @@ class FilerServer:
                     fwd = dict(req)
                     fwd["fid"] = fid
                     fwd.pop("path", None)
+                    if self.jwt_read_key:
+                        # volume-side /_query enforces the fid-scoped read
+                        # gate; mint the token so locality still engages in
+                        # auth-enabled deployments
+                        from ..security import gen_jwt
+
+                        fwd["auth"] = gen_jwt(self.jwt_read_key, fid)
                     r = http_json(
                         "POST", f"http://{loc['url']}/_query", fwd, timeout=30
                     )
@@ -517,14 +528,21 @@ class FilerServer:
             return data
         fid = FileId.parse(file_id)
         locs = self._lookup.lookup(fid.volume_id)
+        from ..security import read_auth_query
+
+        auth = read_auth_query(self.jwt_read_key, file_id)
         for loc in locs:
-            status, body = http_bytes("GET", f"http://{loc['url']}/{file_id}")
+            status, body = http_bytes(
+                "GET", f"http://{loc['url']}/{file_id}{auth}"
+            )
             if status == 200:
                 data = body
                 break
         if data is None:
             self._lookup.invalidate(fid.volume_id)
-            data = operation.download(self.master_url, file_id)
+            data = operation.download(
+                self.master_url, file_id, jwt_read_key=self.jwt_read_key
+            )
         # the cache (incl. its on-disk tiers) holds ciphertext only
         self.chunk_cache.put(file_id, data)
         return data

@@ -324,11 +324,9 @@ class VolumeServer:
 
         if self._chunk_lookup is None:
             self._chunk_lookup = operation.LookupCache(self.master_url)
-        auth = ""
-        if self.jwt_read_key:
-            from ..security import gen_jwt
+        from ..security import read_auth_query
 
-            auth = "?auth=" + gen_jwt(self.jwt_read_key, fid)
+        auth = read_auth_query(self.jwt_read_key, fid)
         try:
             locs = self._chunk_lookup.lookup(vid)
         except Exception:

@@ -426,6 +426,14 @@ class Volume:
             n.append_at_ns = append_at_ns or time.time_ns()
             blob = n.to_bytes(self.version)
             if self.turbo is not None:
+                if n.id == 0xFFFFFFFFFFFFFFFF:
+                    # the native map's EMPTY_KEY slot sentinel: a record
+                    # stored under it would vanish on the next table grow,
+                    # so refuse loudly instead of acking a doomed write
+                    raise VolumeError(
+                        "key ffffffffffffffff is reserved on native-attached"
+                        " volumes"
+                    )
                 # the native engine owns the append (dat + idx + map updated
                 # atomically under its per-volume lock)
                 offset = self.turbo.append(self.id, n.id, blob, n.size, False)

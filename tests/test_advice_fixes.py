@@ -351,3 +351,142 @@ def test_pooled_retry_only_for_idempotent_requests():
         stop.set()
         t.join(timeout=2)
         lsock.close()
+
+
+# -- round-3 advisor findings -------------------------------------------------
+
+
+def _turbo_ok():
+    try:
+        from seaweedfs_tpu.native.turbo import turbo_available
+
+        return turbo_available()
+    except Exception:
+        return False
+
+
+def test_sentinel_fid_key_never_silently_dropped(tmp_path):
+    """Key 0xFFFFFFFFFFFFFFFF collides with the native needle map's
+    EMPTY_KEY slot sentinel (ADVICE r3): it used to be ACKed 201 and then
+    silently dropped by the next table grow. It must now be refused up
+    front — and a grow must never lose an acknowledged write."""
+    if not _turbo_ok():
+        pytest.skip("native turbo library unavailable")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    vs = VolumeServer(
+        [str(tmp_path / "v")], port=free_port(), master_url=master.url,
+        pulse_seconds=0.5,
+    ).start()
+    try:
+        assert vs.turbo is not None
+        fid0 = operation.submit(master.url, b"warm")
+        vid = int(fid0.split(",")[0])
+        url = f"http://127.0.0.1:{vs.port}"
+        sentinel = f"{vid},ffffffffffffffff0a1b2c3d"
+        status, body = http_bytes("POST", f"{url}/{sentinel}", b"doomed")
+        assert status != 201, body  # refused, never acked
+        st, _ = http_bytes("GET", f"{url}/{sentinel}")
+        assert st == 404
+        # key + _delta overflow must not wrap into the sentinel either
+        wrap = f"{vid},fffffffffffffffe0a1b2c3d_1"
+        status, body = http_bytes("POST", f"{url}/{wrap}", b"doomed")
+        assert status != 201, body
+        # the engine stays attached and healthy across table grows (the
+        # grow is what dropped sentinel-keyed writes): 1500 inserts force
+        # several doublings of the 1024-slot initial table
+        payload = b"x" * 32
+        fids = [f"{vid},{i + 16:x}deadbeef" for i in range(1500)]
+        for fid in fids:
+            st, _ = http_bytes("POST", f"{url}/{fid}", payload)
+            assert st == 201
+        for fid in fids[:: 50] + [fid0]:
+            st, data = http_bytes("GET", f"{url}/{fid}")
+            assert st == 200
+        assert vs.turbo.counters()["posts"] >= 1500
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_oversize_content_length_rejected_before_buffering(tmp_path):
+    """A Content-Length beyond the 1 GiB needle bound must be refused at
+    header-parse time, before the read loop buffers gigabytes (ADVICE r3)."""
+    if not _turbo_ok():
+        pytest.skip("native turbo library unavailable")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    vs = VolumeServer(
+        [str(tmp_path / "v")], port=free_port(), master_url=master.url,
+        pulse_seconds=0.5,
+    ).start()
+    try:
+        assert vs.turbo is not None
+        fid = operation.submit(master.url, b"warm")
+        vid = int(fid.split(",")[0])
+        s = socket.create_connection(("127.0.0.1", vs.port), timeout=10)
+        # headers complete, 1.9 GB body promised but never sent: the old
+        # engine would buffer waiting for it; the fixed one answers 400 now
+        s.sendall(
+            f"POST /{vid},42cafebabe HTTP/1.1\r\n"
+            f"Host: x\r\nContent-Length: 1900000000\r\n\r\n".encode()
+        )
+        s.settimeout(10)
+        resp = s.recv(4096)
+        s.close()
+        assert b"400" in resp.split(b"\r\n", 1)[0], resp
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_fuse_gated_on_x86_64(monkeypatch):
+    """The ctypes struct layouts encode the x86_64 ABI; other arches must
+    report fuse unavailable instead of serving garbage stat()s (ADVICE r3)."""
+    import platform as _platform
+
+    from seaweedfs_tpu.mount import fuse_mount as fm
+
+    monkeypatch.setattr(_platform, "machine", lambda: "aarch64")
+    assert fm.fuse_available() is False
+
+
+def test_filer_reads_and_data_local_query_under_read_jwt(tmp_path):
+    """With jwt.signing.read.key enabled on the volume servers, the filer
+    must mint fid-scoped read tokens for its chunk fetches AND for the
+    data-local /_query forward — locality must engage, not 401-and-fall-
+    back (ADVICE r3)."""
+    KEY = "read-secret"
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    vs = VolumeServer(
+        [str(tmp_path / "v")], port=free_port(), master_url=master.url,
+        pulse_seconds=0.5, jwt_read_key=KEY,
+    ).start()
+    filer = FilerServer(
+        port=free_port(), master_url=master.url, jwt_read_key=KEY,
+    ).start()
+    try:
+        doc = b'{"city": "ams", "n": 1}\n{"city": "nyc", "n": 2}\n'
+        st, _ = http_bytes("POST", f"http://{filer.url}/q/data.json", doc)
+        assert st == 201
+        # filer read path: chunk fetch must carry the read token
+        st, data = http_bytes("GET", f"http://{filer.url}/q/data.json")
+        assert st == 200 and data == doc
+        # sabotage the filer-side fallback: only the volume-local execution
+        # can answer, so a 401 on the forward would fail the test
+        def _no_fallback(entry, offset, size):
+            raise AssertionError("data-local query fell back to the filer")
+
+        filer._read_range = _no_fallback
+        r = http_json(
+            "POST",
+            f"http://{filer.url}/_query",
+            {
+                "path": "/q/data.json",
+                "input": "json",
+                "where": {"field": "city", "op": "=", "value": "ams"},
+            },
+        )
+        assert r.get("count") == 1 and r["rows"][0]["n"] == 1, r
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()
