@@ -376,21 +376,25 @@ class _BenchPump:
                     finish(slot, False)
                     feed(slot)
                     continue
-                slot["buf"] += chunk
-                he = slot["buf"].find(b"\r\n\r\n")
+                buf = slot["buf"] = (slot["buf"] + chunk) if slot["buf"] else chunk
+                he = buf.find(b"\r\n\r\n")
                 if he < 0:
                     continue
-                head = slot["buf"][:he].lower()
+                # canonical spelling first (what the turbo engine and the
+                # Python http layer both emit); the lower() fallback only
+                # pays its allocation for odd peers
+                ix = buf.find(b"Content-Length:", 0, he)
+                if ix < 0:
+                    ix = buf[:he].lower().find(b"content-length:")
                 cl = 0
-                ix = head.find(b"content-length:")
                 if ix >= 0:
-                    end = head.find(b"\r\n", ix)
-                    if end < 0:
-                        end = len(head)
-                    cl = int(head[ix + 15:end].strip())
-                if len(slot["buf"]) < he + 4 + cl:
+                    end = buf.find(b"\r\n", ix)
+                    if end < 0 or end > he:
+                        end = he
+                    cl = int(buf[ix + 15:end])
+                if len(buf) < he + 4 + cl:
                     continue
-                status = int(slot["buf"][9:12])
+                status = int(buf[9:12])
                 finish(slot, 200 <= status < 300)
                 feed(slot)
         return time.perf_counter() - t_start

@@ -327,11 +327,33 @@ class TpuCodec(Codec):
         """Stage host bytes into HBM (async; the overlap pipeline's H2D leg)."""
         return self._jax.device_put(data)
 
+    def device_memory_free(self) -> Optional[int]:
+        """Free HBM bytes on the codec's device, or None when the runtime
+        doesn't expose allocator stats (CPU, some backends). The chip may be
+        shared, so this is a snapshot — callers budget with headroom."""
+        try:
+            stats = self._jax.local_devices()[0].memory_stats()
+            return max(0, stats["bytes_limit"] - stats["bytes_in_use"])
+        except Exception:
+            return None
+
     def matmul_device(self, matrix: np.ndarray, data_dev):
         """Device-resident matmul: data_dev is a jax array (k, N) already in
-        HBM; returns a jax array (R, N). N must be ≤ chunk and tile-aligned
-        (or ≤ one tile). This is the zero-copy path used by the benchmark and
-        the streaming encoder's overlap pipeline."""
+        HBM; returns a jax array (R, N). N must be tile-aligned (or ≤ one
+        tile). Widths beyond chunk_bytes are split into chunk-sized launches
+        (one huge Mosaic grid would materialise grid-wide buffers and
+        RESOURCE_EXHAUST; bounded launches stream through the same HBM
+        working set regardless of N). This is the zero-copy path used by the
+        benchmark and the streaming encoder's overlap pipeline."""
+        n = data_dev.shape[1]
+        if n > self.chunk_bytes:
+            outs = []
+            pos = 0
+            while pos < n:
+                end = min(pos + self.chunk_bytes, n)
+                outs.append(self.matmul_device(matrix, data_dev[:, pos:end]))
+                pos = end
+            return self._jax.numpy.concatenate(outs, axis=1)
         if self.use_pallas and data_dev.shape[1] % min(
             self.pallas_tile, data_dev.shape[1]
         ) == 0:

@@ -620,7 +620,7 @@ static bool send_all_blocking(int fd, const char* data, size_t len) {
   return true;
 }
 
-static std::string status_text(int code) {
+static const char* status_text(int code) {
   switch (code) {
     case 200: return "OK";
     case 201: return "Created";
@@ -639,18 +639,60 @@ static std::string status_text(int code) {
   }
 }
 
+// header + body in ONE sendmsg (MSG_NOSIGNAL: no SIGPIPE on dead peers):
+// two send()s per GET meant two packets on loopback and often two client
+// select()+recv() rounds per request — measurable at small-file rps scale.
+static bool conn_send2(Worker* w, Conn* c, const char* hdr, size_t hlen,
+                       const char* body, size_t blen) {
+  if (!c->out.empty()) {  // EPOLLOUT already armed; just queue
+    c->out.append(hdr, hlen);
+    c->out.append(body, blen);
+    return true;
+  }
+  iovec iov[2] = {{(void*)hdr, hlen}, {(void*)body, blen}};
+  int idx = 0;  // a zero-length body iov is harmless; skipping hdr is not
+  while (idx < 2) {
+    msghdr msg{};
+    msg.msg_iov = iov + idx;
+    msg.msg_iovlen = 2 - idx;
+    ssize_t n = sendmsg(c->fd, &msg, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      return false;  // peer gone
+    }
+    size_t left = n;
+    while (idx < 2 && left >= iov[idx].iov_len) {
+      left -= iov[idx].iov_len;
+      idx++;
+    }
+    if (idx < 2) {
+      iov[idx].iov_base = (char*)iov[idx].iov_base + left;
+      iov[idx].iov_len -= left;
+    }
+  }
+  if (idx < 2) {
+    for (int j = idx; j < 2; j++)
+      c->out.append((const char*)iov[j].iov_base, iov[j].iov_len);
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.fd = c->fd;
+    epoll_ctl(w->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
+  return true;
+}
+
 static bool reply(Worker* w, Conn* c, int code, const char* ctype,
                   const char* extra_headers, const char* body, size_t body_len,
                   bool head_only) {
   char hdr[512];
   int hn = snprintf(hdr, sizeof(hdr),
                     "HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\n%s%s\r\n",
-                    code, status_text(code).c_str(), ctype, body_len,
+                    code, status_text(code), ctype, body_len,
                     extra_headers ? extra_headers : "",
                     c->close_after ? "Connection: close\r\n" : "");
-  if (!conn_send(w, c, hdr, hn)) return false;
-  if (!head_only && body_len > 0) return conn_send(w, c, body, body_len);
-  return true;
+  if (head_only || body_len == 0) return conn_send(w, c, hdr, hn);
+  return conn_send2(w, c, hdr, hn, body, body_len);
 }
 
 static bool reply_json(Worker* w, Conn* c, int code, const std::string& js,
@@ -1111,7 +1153,14 @@ static int handle_get(Worker* w, Conn* c, const Req& r, const Fid& f,
                  "Accept-Ranges: bytes\r\n", "", 0, head_only) ? 0 : -1;
 
   int64_t rec_len = actual_size(size, vol->version);
-  std::vector<uint8_t> rec(rec_len);
+  // per-worker scratch for the common small-needle case: no per-request
+  // malloc + zero-fill. Big records get a one-off buffer instead so a
+  // single large GET can't pin megabytes of worker RSS forever.
+  static const int64_t SCRATCH_MAX = 4 << 20;
+  static thread_local std::vector<uint8_t> scratch;
+  std::vector<uint8_t> big;
+  std::vector<uint8_t>& rec = rec_len <= SCRATCH_MAX ? scratch : big;
+  if (rec.size() < (size_t)rec_len) rec.resize(rec_len);
   ssize_t got = pread(vol->dat_fd, rec.data(), rec_len, off);
   if (got != rec_len)
     return reply_json(w, c, 500, "{\"error\": \"short read from .dat\"}",
