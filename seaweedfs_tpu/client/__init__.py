@@ -1,0 +1,26 @@
+"""Standalone client package: the HDFS-gateway / Java-client analog.
+
+The reference ships `other/java/client` (FilerClient.java:1 — entry CRUD +
+chunked IO) and `other/java/hdfs2` (SeaweedFileSystem.java:1 — a Hadoop
+`FileSystem` so Spark/Hive/MapReduce can mount the filer). The Python-era
+equivalent of "the Hadoop ecosystem can mount it" is fsspec: pandas,
+pyarrow, dask, duckdb and torch data loaders all speak
+`fsspec.AbstractFileSystem`. This package provides that adapter plus a
+plain `FilerClient` for entry-level access.
+
+Usage::
+
+    import fsspec
+    from seaweedfs_tpu.client import register
+    register()
+    fs = fsspec.filesystem("seaweedfs", filer="127.0.0.1:8888")
+    fs.ls("/")
+    with fs.open("/data/part-0.parquet", "rb") as f: ...
+
+or URL-style, once registered: ``fsspec.open("seaweedfs://127.0.0.1:8888/a/b")``.
+"""
+
+from ..filer.client import FilerClient  # noqa: F401 — entry-level client
+from .fs import SeaweedFile, SeaweedFileSystem, register  # noqa: F401
+
+__all__ = ["FilerClient", "SeaweedFile", "SeaweedFileSystem", "register"]

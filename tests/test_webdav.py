@@ -142,3 +142,165 @@ def test_delete(webdav):
     assert status == 204
     assert dav("GET", f"{base}/del.txt")[0] == 404
     assert dav("DELETE", f"{base}/del.txt")[0] == 404
+
+
+# -- class 2: LOCK / UNLOCK / If: enforcement (x/net/webdav parity) -----------
+
+LOCKINFO = (
+    b'<?xml version="1.0" encoding="utf-8"?>'
+    b'<D:lockinfo xmlns:D="DAV:">'
+    b"<D:lockscope><D:exclusive/></D:lockscope>"
+    b"<D:locktype><D:write/></D:locktype>"
+    b"<D:owner><D:href>litmus</D:href></D:owner>"
+    b"</D:lockinfo>"
+)
+
+
+def _token(headers):
+    return headers["Lock-Token"].strip("<>")
+
+
+def test_lock_put_unlock_trace(webdav):
+    """The litmus 'locks' suite core trace: lock -> put-without-token 423 ->
+    put-with-token ok -> unlock -> put ok."""
+    base = f"http://{webdav.url}"
+    st, _, _ = dav("PUT", f"{base}/locked.txt", b"v1")
+    assert st in (201, 204)
+    st, body, h = dav("LOCK", f"{base}/locked.txt", LOCKINFO,
+                      {"Timeout": "Second-600"})
+    assert st == 200, body
+    token = _token(h)
+    assert token.startswith("opaquelocktoken:")
+    assert b"lockdiscovery" in body and token.encode() in body
+    # a second exclusive lock must be refused
+    st, _, _ = dav("LOCK", f"{base}/locked.txt", LOCKINFO)
+    assert st == 423
+    # writes without the token are refused
+    st, _, _ = dav("PUT", f"{base}/locked.txt", b"v2")
+    assert st == 423
+    st, _, _ = dav("DELETE", f"{base}/locked.txt")
+    assert st == 423
+    st, _, _ = dav("MOVE", f"{base}/locked.txt", b"",
+                   {"Destination": f"{base}/elsewhere.txt"})
+    assert st == 423
+    # with the token: write goes through, content replaced
+    st, _, _ = dav("PUT", f"{base}/locked.txt", b"v2",
+                   {"If": f"(<{token}>)"})
+    assert st == 204
+    st, body, _ = dav("GET", f"{base}/locked.txt")
+    assert (st, body) == (200, b"v2")
+    # PROPFIND shows the active lock
+    st, body, _ = dav("PROPFIND", f"{base}/locked.txt", b"", {"Depth": "0"})
+    assert st == 207 and token.encode() in body
+    # unlock: wrong token 409, right token 204, then writes are open again
+    st, _, _ = dav("UNLOCK", f"{base}/locked.txt", b"",
+                   {"Lock-Token": "<opaquelocktoken:bogus>"})
+    assert st == 409
+    st, _, _ = dav("UNLOCK", f"{base}/locked.txt", b"",
+                   {"Lock-Token": f"<{token}>"})
+    assert st == 204
+    st, _, _ = dav("PUT", f"{base}/locked.txt", b"v3")
+    assert st == 204
+
+
+def test_lock_null_creates_resource(webdav):
+    """LOCK on an unmapped URL creates an empty resource and returns 201
+    (RFC 4918 7.3; x/net/webdav behavior)."""
+    base = f"http://{webdav.url}"
+    st, _, h = dav("LOCK", f"{base}/tolock/fresh.txt", LOCKINFO)
+    assert st == 201
+    token = _token(h)
+    st, body, _ = dav("GET", f"{base}/tolock/fresh.txt")
+    assert (st, body) == (200, b"")
+    dav("UNLOCK", f"{base}/tolock/fresh.txt", b"",
+        {"Lock-Token": f"<{token}>"})
+
+
+def test_depth_infinity_collection_lock(webdav):
+    base = f"http://{webdav.url}"
+    dav("MKCOL", f"{base}/proj/")
+    st, _, h = dav("LOCK", f"{base}/proj/", LOCKINFO,
+                   {"Depth": "infinity"})
+    assert st == 200
+    token = _token(h)
+    # children are covered by the collection lock
+    st, _, _ = dav("PUT", f"{base}/proj/child.txt", b"x")
+    assert st == 423
+    st, _, _ = dav("PUT", f"{base}/proj/child.txt", b"x",
+                   {"If": f"(<{token}>)"})
+    assert st == 201
+    # locking a parent over an existing child lock is refused
+    st2, _, _ = dav("LOCK", f"{base}/proj/", LOCKINFO)
+    assert st2 == 423
+    dav("UNLOCK", f"{base}/proj/", b"", {"Lock-Token": f"<{token}>"})
+
+
+def test_lock_refresh_and_expiry(webdav):
+    base = f"http://{webdav.url}"
+    dav("PUT", f"{base}/fleeting.txt", b"x")
+    st, _, h = dav("LOCK", f"{base}/fleeting.txt", LOCKINFO,
+                   {"Timeout": "Second-1"})
+    assert st == 200
+    token = _token(h)
+    # refresh with empty body + If token
+    st, body, _ = dav("LOCK", f"{base}/fleeting.txt", b"",
+                      {"If": f"(<{token}>)", "Timeout": "Second-600"})
+    assert st == 200 and b"Second-600" in body
+    # refresh without the token is a failed precondition
+    st, _, _ = dav("LOCK", f"{base}/fleeting.txt", b"")
+    assert st == 412
+    dav("UNLOCK", f"{base}/fleeting.txt", b"", {"Lock-Token": f"<{token}>"})
+    # expiry: a 1-second lock stops blocking writes once it lapses
+    st, _, h = dav("LOCK", f"{base}/fleeting.txt", LOCKINFO,
+                   {"Timeout": "Second-1"})
+    assert st == 200
+    time.sleep(1.3)
+    st, _, _ = dav("PUT", f"{base}/fleeting.txt", b"after expiry")
+    assert st == 204
+
+
+def test_proppatch_dead_properties(webdav):
+    base = f"http://{webdav.url}"
+    dav("PUT", f"{base}/prop.txt", b"x")
+    update = (
+        b'<?xml version="1.0" encoding="utf-8"?>'
+        b'<D:propertyupdate xmlns:D="DAV:" xmlns:Z="urn:x-test:">'
+        b"<D:set><D:prop><Z:color>indigo</Z:color></D:prop></D:set>"
+        b"</D:propertyupdate>"
+    )
+    st, body, _ = dav("PROPPATCH", f"{base}/prop.txt", update)
+    assert st == 207 and b"200 OK" in body
+    st, body, _ = dav("PROPFIND", f"{base}/prop.txt", b"", {"Depth": "0"})
+    assert st == 207 and b"indigo" in body
+    remove = (
+        b'<?xml version="1.0" encoding="utf-8"?>'
+        b'<D:propertyupdate xmlns:D="DAV:" xmlns:Z="urn:x-test:">'
+        b"<D:remove><D:prop><Z:color/></D:prop></D:remove>"
+        b"</D:propertyupdate>"
+    )
+    st, _, _ = dav("PROPPATCH", f"{base}/prop.txt", remove)
+    assert st == 207
+    st, body, _ = dav("PROPFIND", f"{base}/prop.txt", b"", {"Depth": "0"})
+    assert st == 207 and b"indigo" not in body
+
+
+def test_move_respects_child_locks_and_releases_source_locks(webdav):
+    base = f"http://{webdav.url}"
+    dav("MKCOL", f"{base}/mv/")
+    dav("PUT", f"{base}/mv/inner.txt", b"x")
+    st, _, h = dav("LOCK", f"{base}/mv/inner.txt", LOCKINFO)
+    assert st == 200
+    token = _token(h)
+    # moving the parent collection is blocked by the child's lock
+    st, _, _ = dav("MOVE", f"{base}/mv/", b"",
+                   {"Destination": f"{base}/mv2/"})
+    assert st == 423
+    # with the token the move goes through, and the lock dies with the old
+    # URL (RFC 4918 7.5: locks are not moved)
+    st, _, _ = dav("MOVE", f"{base}/mv/", b"",
+                   {"Destination": f"{base}/mv2/", "If": f"(<{token}>)"})
+    assert st in (201, 204)
+    st, _, _ = dav("PUT", f"{base}/mv/inner.txt", b"fresh")  # old URL writable
+    assert st == 201
+    st, _, _ = dav("PUT", f"{base}/mv2/inner.txt", b"new")  # new URL unlocked
+    assert st == 204
