@@ -769,6 +769,159 @@ def cmd_shell(args):
     run_shell(args.master, args.filer, command=args.command)
 
 
+def cmd_dump_dat(args):
+    """Print every record in a volume .dat, byte-walk only — the see_dat
+    analog (`unmaintained/see_dat/see_dat.go:1`). Strictly read-only: no
+    needle map is built and no .idx is created or touched, so it is safe on
+    a forensic copy."""
+    from .storage.needle import (
+        NEEDLE_HEADER_SIZE,
+        Needle,
+        needle_body_length,
+        parse_needle_header,
+    )
+    from .storage.super_block import SuperBlock
+    from .storage.volume import volume_file_name
+
+    base = volume_file_name(args.dir, args.collection, args.volume_id)
+    with open(base + ".dat", "rb") as f:
+        raw = f.read(64)
+        sb = SuperBlock.from_bytes(raw)
+        offset = sb.block_size()
+        f.seek(0, 2)
+        size = f.tell()
+        print(
+            f"# volume {args.volume_id} version {sb.version} "
+            f"replication {sb.replica_placement} "
+            f"compactRevision {sb.compaction_revision} size {size}"
+        )
+        count = 0
+        while offset + NEEDLE_HEADER_SIZE <= size:
+            f.seek(offset)
+            hdr = f.read(NEEDLE_HEADER_SIZE)
+            if len(hdr) < NEEDLE_HEADER_SIZE:
+                break
+            cookie, nid, nsize = parse_needle_header(hdr)
+            body_len = needle_body_length(max(nsize, 0), sb.version)
+            total = NEEDLE_HEADER_SIZE + body_len
+            if offset + total > size:
+                print(f"# torn record at offset {offset} (truncated write?)")
+                break
+            n = Needle(cookie=cookie, id=nid, size=nsize)
+            ts = ""
+            try:
+                n.read_body_bytes(f.read(body_len), sb.version)
+                if n.append_at_ns:
+                    from datetime import datetime
+
+                    ts = " appendedAt " + datetime.fromtimestamp(
+                        n.append_at_ns / 1e9
+                    ).isoformat()
+            except Exception as e:  # noqa: BLE001 — forensics keeps walking
+                ts = f" BODY-ERROR {e}"
+            # the .dat alone cannot tell a zero-byte put from a deletion
+            # marker (both append size-0 records); only the idx replay can
+            kind = (
+                "size 0 (empty-or-tombstone)" if nsize <= 0 else f"size {nsize}"
+            )
+            print(
+                f"{args.volume_id},{nid:x}{cookie:08x} offset {offset} "
+                f"{kind} data {len(n.data)}B{ts}"
+            )
+            count += 1
+            offset += total
+        print(f"# {count} records")
+
+
+def cmd_dump_idx(args):
+    """Print every .idx/.ecx entry in file order — the see_idx analog
+    (`unmaintained/see_idx/see_idx.go:1`)."""
+    from .storage import idx as idx_mod
+    from .storage.types import TOMBSTONE_FILE_SIZE
+    from .storage.volume import volume_file_name
+
+    base = volume_file_name(args.dir, args.collection, args.volume_id)
+    path = base + args.ext
+    count = 0
+    with open(path, "rb") as f:
+        for key, offset, size in idx_mod.iter_index_file(f, args.offset_size):
+            tag = ""
+            if size == TOMBSTONE_FILE_SIZE or offset == 0:
+                tag = " (tombstone)"
+            print(f"key:{key:x} offset:{offset} size:{size}{tag}")
+            count += 1
+    print(f"# {count} entries")
+
+
+def cmd_diff_servers(args):
+    """Diff one volume's live needle state across servers — the
+    diff_volume_servers analog (`unmaintained/diff_volume_servers/
+    diff_volume_servers.go:34`): for each needle that differs, print
+    `<fid> <server> missing|deleted|notDeleted|wrongSize`."""
+    import io as _io
+
+    from .server.http_util import http_bytes
+    from .storage import idx as idx_mod
+    from .storage.types import TOMBSTONE_FILE_SIZE
+
+    servers = [s for s in args.volume_servers.split(",") if s]
+    if len(servers) < 2:
+        raise SystemExit("need at least two -volumeServers to diff")
+    vid = args.volume_id
+    states: dict[str, dict[int, int]] = {}  # addr → {key: size|-1 deleted}
+    for addr in servers:
+        status, data = http_bytes(
+            "GET",
+            f"http://{addr}/admin/file?volume={vid}"
+            f"&collection={args.collection}&ext=.idx",
+        )
+        if status != 200:
+            raise SystemExit(f"{addr}: fetching volume {vid} idx: HTTP {status}")
+        live: dict[int, int] = {}
+        for key, offset, size in idx_mod.iter_index_file(
+            _io.BytesIO(data), args.offset_size
+        ):
+            if offset == 0 or size == TOMBSTONE_FILE_SIZE:
+                live[key] = -1  # deleted (tombstone recorded)
+            else:
+                live[key] = size
+        states[addr] = live
+    every = set()
+    for live in states.values():
+        every.update(live)
+    diffs = 0
+    for key in sorted(every):
+        vals = {addr: states[addr].get(key) for addr in servers}
+        present = {v for v in vals.values()}
+        if len(present) <= 1:
+            continue  # identical everywhere
+        # report against the majority view, like the reference's per-server
+        # message: what is wrong ON that server
+        for addr, v in vals.items():
+            others = [ov for a, ov in vals.items() if a != addr]
+            ref = max(set(others), key=others.count)
+            if v == ref:
+                continue
+            if v is None:
+                msg = "missing"
+            elif ref is None:
+                # this server HAS the needle; the peers that lack it get
+                # their own 'missing' lines — calling this one wrongSize
+                # would send the operator hunting phantom corruption
+                continue
+            elif v == -1:
+                msg = "deleted"
+            elif ref == -1:
+                msg = "notDeleted"
+            else:
+                msg = "wrongSize"
+            print(f"{vid},{key:x} {addr} {msg}")
+            diffs += 1
+    print(f"# {diffs} differences across {len(servers)} servers")
+    if diffs:
+        raise SystemExit(1)
+
+
 def cmd_fix(args):
     """Re-create a volume's .idx from its .dat (`weed fix`, command/fix.go)."""
     from .storage.volume import Volume, volume_file_name
@@ -1122,6 +1275,35 @@ def main(argv=None):
     ex.add_argument("-newer", default="",
                     help="only files newer than ISO timestamp")
     ex.set_defaults(fn=cmd_export)
+
+    dd = sub.add_parser("dump.dat",
+                        help="print every .dat record (see_dat analog)")
+    dd.add_argument("-dir", default=".")
+    dd.add_argument("-collection", default="")
+    dd.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    dd.set_defaults(fn=cmd_dump_dat)
+
+    di = sub.add_parser("dump.idx",
+                        help="print every .idx entry (see_idx analog)")
+    di.add_argument("-dir", default=".")
+    di.add_argument("-collection", default="")
+    di.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    di.add_argument("-ext", default=".idx", choices=[".idx", ".ecx"])
+    di.add_argument("-offsetSize", dest="offset_size", type=int, default=4,
+                    choices=[4, 5])
+    di.set_defaults(fn=cmd_dump_idx)
+
+    ds = sub.add_parser(
+        "diff.servers",
+        help="diff a volume across servers (diff_volume_servers analog)",
+    )
+    ds.add_argument("-volumeServers", dest="volume_servers", required=True,
+                    help="comma-delimited host:port list")
+    ds.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    ds.add_argument("-collection", default="")
+    ds.add_argument("-offsetSize", dest="offset_size", type=int, default=4,
+                    choices=[4, 5])
+    ds.set_defaults(fn=cmd_diff_servers)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=cmd_version)

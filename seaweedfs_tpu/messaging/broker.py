@@ -97,11 +97,26 @@ class TopicManager:
             "partitions": int(e.get("extended", {}).get("partitions", 1)),
         }
 
+    def delete_topic(self, ns: str, topic: str) -> dict:
+        """DeleteTopic rpc analog (messaging.proto): drop the topic's log
+        tree + conf from the filer and evict live partitions. The filer
+        delete happens INSIDE the lock so a concurrent publish can't slip a
+        fresh partition in between eviction and tree removal; get_partition
+        re-checks the conf before creating, so post-delete publishes fail
+        with 'no such topic' instead of resurrecting orphan log files."""
+        with self._lock:
+            for key in [k for k in self._partitions if k[0] == ns and k[1] == topic]:
+                self._partitions.pop(key).close()
+            self.client.delete(f"{TOPICS_ROOT}/{ns}/{topic}", recursive=True)
+        return {"namespace": ns, "topic": topic, "deleted": True}
+
     def get_partition(self, ns: str, topic: str, partition: int) -> TopicPartition:
         key = (ns, topic, partition)
         with self._lock:
             tp = self._partitions.get(key)
             if tp is None:
+                if self.topic_conf(ns, topic) is None:
+                    raise KeyError(f"no such topic {ns}/{topic}")
                 tp = TopicPartition(self.client, ns, topic, partition)
                 self._partitions[key] = tp
         return tp
@@ -130,7 +145,10 @@ class Broker:
     # /pub/<ns>/<topic>/<partition>
     def _h_pub(self, h, path, q, body):
         _, _, ns, topic, part = path.split("/", 4)
-        tp = self.topics.get_partition(ns, topic, int(part))
+        try:
+            tp = self.topics.get_partition(ns, topic, int(part))
+        except KeyError as e:
+            return 404, {"error": str(e)}
         key = base64.b64decode(h.headers.get("X-Msg-Key", "") or "")
         ts = tp.publish(key, body)
         return 200, {"ts_ns": ts}
@@ -138,7 +156,10 @@ class Broker:
     # /sub/<ns>/<topic>/<partition>?since_ns=&limit=
     def _h_sub(self, h, path, q, body):
         _, _, ns, topic, part = path.split("/", 4)
-        tp = self.topics.get_partition(ns, topic, int(part))
+        try:
+            tp = self.topics.get_partition(ns, topic, int(part))
+        except KeyError as e:
+            return 404, {"error": str(e)}
         msgs = tp.read(int(q.get("since_ns", 0)), int(q.get("limit", 1000)))
         out = [
             {
@@ -160,6 +181,8 @@ class Broker:
             return 400, {"error": "need /topics/<ns>/<topic>"}
         ns, topic = parts[2], parts[3]
         if h.command == "POST":
+            if q.get("op") == "delete":
+                return 200, self.topics.delete_topic(ns, topic)
             return 200, self.topics.create_topic(
                 ns, topic, int(q.get("partitions", 4))
             )

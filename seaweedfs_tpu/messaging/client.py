@@ -32,6 +32,16 @@ class MessagingClient:
     def topic_conf(self, ns: str, topic: str) -> dict:
         return http_json("GET", f"http://{self.brokers[0]}/topics/{ns}/{topic}")
 
+    def delete_topic(self, ns: str, topic: str) -> dict:
+        """DeleteTopic (messaging.proto): drop log tree + conf everywhere —
+        every broker may hold live partitions of it."""
+        out = {}
+        for b in self.brokers:
+            out = http_json(
+                "POST", f"http://{b}/topics/{ns}/{topic}?op=delete"
+            )
+        return out
+
     # -- publish -------------------------------------------------------------
     def publish(
         self,

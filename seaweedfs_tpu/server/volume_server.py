@@ -585,12 +585,73 @@ class VolumeServer:
         )
         return 200, {}
 
+    def _h_batch_delete(self, h, path, q, body):
+        """BatchDelete rpc analog (pb/volume_server.proto BatchDelete,
+        delete_content.go:32): delete many locally-held needles in ONE
+        request with per-fid results. Local-only, like the reference — the
+        client fans the batch out to every replica location itself."""
+        if not self.guard.allowed(h.client_address[0]):
+            return 403, {"error": "ip not allowed"}
+        req = json.loads(body)
+        auths = req.get("auths", {})
+        results = []
+        for fid in req.get("fids", []):
+            item = {"fid": fid}
+            try:
+                vid, nid, cookie = self._parse_fid_path("/" + fid)
+            except Exception as e:  # noqa: BLE001 — per-fid isolation
+                item.update(status=400, error=f"bad fid: {e}")
+                results.append(item)
+                continue
+            if self.jwt_signing_key:
+                from ..security import verify_fid_jwt
+
+                if not verify_fid_jwt(
+                    self.jwt_signing_key, auths.get(fid, ""),
+                    fid.replace("/", ","),
+                ):
+                    item.update(status=401, error="unauthorized delete")
+                    results.append(item)
+                    continue
+            try:
+                # chunk manifests must go through the single-fid DELETE so
+                # their data chunks cascade (the reference's BatchDelete
+                # refuses them the same way, volume_server_handlers_write.go)
+                probe = Needle(id=nid)
+                try:
+                    self.store.read_volume_needle(vid, probe)
+                except Exception:  # noqa: BLE001 — absent/deleted: fine
+                    probe = None
+                if probe is not None and probe.is_chunk_manifest:
+                    item.update(
+                        status=409,
+                        error="chunk manifest: not allowed in batch delete",
+                    )
+                    results.append(item)
+                    continue
+                size = self.store.delete_volume_needle(
+                    vid, Needle(cookie=cookie, id=nid)
+                )
+                item.update(status=202, size=size)
+            except NotFoundError:
+                item.update(status=404, error=f"volume {vid} not found")
+            except Exception as e:  # noqa: BLE001
+                item.update(status=500, error=str(e))
+            results.append(item)
+        return 200, {"results": results}
+
     def _h_delete_volume(self, h, path, q, body):
         ok = self.store.delete_volume(int(q["volume"]))
         return 200, {"deleted": ok}
 
     def _h_readonly(self, h, path, q, body):
         ok = self.store.mark_volume_readonly(int(q["volume"]))
+        return (200, {}) if ok else (404, {"error": "volume not found"})
+
+    def _h_writable(self, h, path, q, body):
+        """VolumeMarkWritable rpc analog (volume_grpc_admin.go) — undo a
+        readonly mark so the volume accepts writes again."""
+        ok = self.store.mark_volume_writable(int(q["volume"]))
         return (200, {}) if ok else (404, {"error": "volume not found"})
 
     def _h_vacuum_check(self, h, path, q, body):
@@ -1126,7 +1187,9 @@ class VolumeServer:
             routes = [
                 ("POST", "/admin/assign_volume", vs._h_assign_volume),
                 ("POST", "/admin/delete_volume", vs._h_delete_volume),
+                ("POST", "/_batch_delete", vs._h_batch_delete),
                 ("POST", "/admin/readonly", vs._h_readonly),
+                ("POST", "/admin/writable", vs._h_writable),
                 ("GET", "/admin/vacuum_check", vs._h_vacuum_check),
                 ("POST", "/admin/vacuum", vs._h_vacuum),
                 ("POST", "/admin/volume_copy", vs._h_volume_copy),

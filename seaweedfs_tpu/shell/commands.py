@@ -109,6 +109,16 @@ def volume_mark_readonly(env: CommandEnv, vid: int) -> None:
         http_json("POST", f"http://{url}/admin/readonly?volume={vid}")
 
 
+def volume_mark(env: CommandEnv, vid: int, writable: bool,
+                node: str = "") -> None:
+    """volume.mark -readonly|-writable (command_volume_mark.go): flip one
+    volume's write gate on its server(s), or on one server with -node."""
+    op = "writable" if writable else "readonly"
+    urls = [node] if node else env.volume_locations(vid)
+    for url in urls:
+        http_json("POST", f"http://{url}/admin/{op}?volume={vid}")
+
+
 # -- EC commands (the north-star workload) ------------------------------------
 def _volume_collection(env: CommandEnv, vid: int) -> str:
     """Resolve a volume's collection from the servers' status reports."""

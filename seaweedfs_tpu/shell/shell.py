@@ -13,6 +13,7 @@ HELP = """commands:
   volume.list                         list volumes on all servers
   volume.vacuum [-garbageThreshold=X] compact garbage volumes
   volume.delete -volumeId=N           delete a volume everywhere
+  volume.mark -volumeId=N -readonly|-writable [-node=H]  flip the write gate
   volume.mark.readonly -volumeId=N    seal a volume
   volume.fix.replication              re-replicate under-replicated volumes
   volume.move -volumeId=N -target=host:port [-source=host:port]
@@ -47,6 +48,8 @@ def _flags(parts: list[str]) -> dict[str, str]:
         if p.startswith("-") and "=" in p:
             k, v = p[1:].split("=", 1)
             out[k] = v
+        elif p.startswith("-") and len(p) > 1:
+            out[p[1:]] = "true"  # bare boolean flag (-readonly, -force)
     return out
 
 
@@ -148,6 +151,14 @@ def run_command(env: CommandEnv, line: str) -> object:
         return "ok"
     if cmd == "volume.mark.readonly":
         C.volume_mark_readonly(env, int(flags["volumeId"]))
+        return "ok"
+    if cmd == "volume.mark":
+        # reference spelling (command_volume_mark.go): -readonly|-writable
+        writable = "writable" in flags
+        if not writable and "readonly" not in flags:
+            raise ValueError("use -readonly or -writable")
+        C.volume_mark(env, int(flags["volumeId"]), writable,
+                      node=flags.get("node", ""))
         return "ok"
     if cmd == "volume.fix.replication":
         return C.volume_fix_replication(env)

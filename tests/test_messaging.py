@@ -153,3 +153,28 @@ def test_subscribe_tail(stack):
     mc.publish("t", "tail", b"third", partition=0)
     th.join(timeout=10)
     assert got == [b"first", b"second", b"third"]
+
+
+def test_delete_topic_drops_log_and_conf(stack):
+    """DeleteTopic rpc analog: conf 404s afterwards and the filer log tree
+    is gone (messaging.proto DeleteTopic)."""
+    brokers, filer = stack
+    mc = MessagingClient([b.url for b in brokers])
+    mc.create_topic("tmp", "doomed", partitions=2)
+    for i in range(5):
+        mc.publish("tmp", "doomed", f"m{i}".encode(), partition=0)
+    import urllib.request
+
+    for b in brokers:
+        urllib.request.urlopen(
+            urllib.request.Request(f"http://{b.url}/_flush", method="POST"),
+            timeout=10,
+        )
+    r = mc.delete_topic("tmp", "doomed")
+    assert r.get("deleted") is True
+    assert mc.topic_conf("tmp", "doomed").get("error")
+    from seaweedfs_tpu.filer.client import FilerClient
+
+    fc = FilerClient(filer.url)
+    assert fc.get_entry("/topics/tmp/doomed/.conf") is None
+    assert fc.list("/topics/tmp/doomed", limit=10) == []

@@ -105,3 +105,100 @@ def test_export_tar_of_live_needles(tmp_path):
         assert len(names) == 13  # 20 written − 7 deleted
         data = tf.extractfile("file15.txt").read()
         assert data == b"needle-15" * 20
+
+
+# -- forensics: dump.dat / dump.idx / diff.servers ----------------------------
+
+
+def test_dump_dat_lists_every_record(tmp_path):
+    vid = _make_volume(tmp_path)
+    idx_mtime_before = os.path.getmtime(tmp_path / f"{vid}.idx")
+    out = _run("dump.dat", "-dir", ".", "-volumeId", str(vid), cwd=tmp_path)
+    assert out.returncode == 0, out.stderr
+    # 20 appends + 7 tombstones = 27 records, each with fid + offset
+    assert "# 27 records" in out.stdout
+    assert out.stdout.count("tombstone") == 7
+    assert f"{vid},f00000005 offset" in out.stdout  # key 15, cookie 5
+    assert "appendedAt 20" in out.stdout
+    # strictly read-only: the .idx was not rewritten
+    assert os.path.getmtime(tmp_path / f"{vid}.idx") == idx_mtime_before
+
+
+def test_dump_idx_lists_entries_and_tombstones(tmp_path):
+    vid = _make_volume(tmp_path)
+    out = _run("dump.idx", "-dir", ".", "-volumeId", str(vid), cwd=tmp_path)
+    assert out.returncode == 0, out.stderr
+    assert "# 27 entries" in out.stdout
+    assert out.stdout.count("(tombstone)") == 7
+    assert "key:14 " in out.stdout
+
+
+def test_diff_servers_reports_divergence(tmp_path):
+    """Two live volume servers with the same volume id diverging in
+    content: diff.servers must name each wrong needle and server."""
+    import socket
+    import time as _time
+
+    from seaweedfs_tpu.server.http_util import http_bytes
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    ms = MasterServer(port=free_port(), node_timeout=60).start()
+    vs1 = VolumeServer([str(tmp_path / "a")], port=free_port(),
+                       master_url=ms.url, pulse_seconds=0.5).start()
+    vs2 = VolumeServer([str(tmp_path / "b")], port=free_port(),
+                       master_url=ms.url, pulse_seconds=0.5).start()
+    try:
+        vid = 7
+        for vs in (vs1, vs2):  # create the same volume id on BOTH servers
+            st, _ = http_bytes(
+                "POST",
+                f"http://127.0.0.1:{vs.port}/admin/assign_volume?volume={vid}"
+                f"&replication=000",
+            )
+            assert st == 200, st
+        for i in (2, 3, 4):
+            for vs in (vs1, vs2):
+                st, _ = http_bytes(
+                    "POST",
+                    f"http://127.0.0.1:{vs.port}/{vid},{i:x}0000beef?type=replicate",
+                    b"same" * i,
+                )
+                assert st == 201
+        # divergence: needle 5 only on vs1; needle 3 deleted only on vs2;
+        # needle 4 rewritten with a different size on vs2
+        st, _ = http_bytes(
+            "POST", f"http://127.0.0.1:{vs1.port}/{vid},50000beef?type=replicate",
+            b"only on one")
+        assert st == 201
+        st, _ = http_bytes(
+            "DELETE", f"http://127.0.0.1:{vs2.port}/{vid},30000beef?type=replicate")
+        assert st in (200, 202)
+        st, _ = http_bytes(
+            "POST", f"http://127.0.0.1:{vs2.port}/{vid},40000beef?type=replicate",
+            b"a very different, longer body")
+        assert st == 201
+        for vs in (vs1, vs2):
+            vs.store.find_volume(vid).sync()
+        servers = f"127.0.0.1:{vs1.port},127.0.0.1:{vs2.port}"
+        out = _run("diff.servers", "-volumeServers", servers,
+                   "-volumeId", str(vid), cwd=tmp_path)
+        assert out.returncode == 1, out.stdout + out.stderr  # differences found
+        lines = out.stdout.splitlines()
+        assert any(l.startswith(f"{vid},5 ") and l.endswith("missing")
+                   for l in lines), lines
+        assert any(l.startswith(f"{vid},3 ") and l.endswith("deleted")
+                   for l in lines), lines
+        assert any(l.startswith(f"{vid},4 ") and l.endswith("wrongSize")
+                   for l in lines), lines
+    finally:
+        vs1.stop()
+        vs2.stop()
+        ms.stop()

@@ -175,3 +175,33 @@ def test_ec_encode_distribute_read_rebuild(cluster):
     )
     for fid, want in blobs.items():
         assert operation.download(master.url, fid) == want, "degraded read failed"
+
+
+def test_batch_delete_and_volume_mark_writable(cluster):
+    """BatchDelete analog (pb/volume_server.proto): one request per volume
+    group deletes many fids; and volume.mark -writable reopens a sealed
+    volume (VolumeMarkWritable)."""
+    master, servers = cluster
+    fids = [operation.submit(master.url, f"bd {i}".encode() * 50)
+            for i in range(12)]
+    assert operation.delete_files(master.url, fids) == 12
+    for fid in fids:
+        try:
+            operation.download(master.url, fid)
+            raise AssertionError(f"{fid} still readable after batch delete")
+        except RuntimeError:
+            pass
+    # deleting again deletes nothing new (size 0 → still 202, but the
+    # needles are gone; count stays stable because 202s are acked deletes)
+    fid = operation.submit(master.url, b"mark me")
+    vid = int(fid.split(",")[0])
+    locs = operation.lookup(master.url, vid)
+    # seal, verify writes refused, reopen via /admin/writable, write again
+    for loc in locs:
+        http_json("POST", f"http://{loc['url']}/admin/readonly?volume={vid}")
+    st, _ = http_bytes("POST", f"http://{locs[0]['url']}/{vid},42deadbeef", b"x")
+    assert st == 500  # read-only volume refuses writes
+    for loc in locs:
+        http_json("POST", f"http://{loc['url']}/admin/writable?volume={vid}")
+    st, _ = http_bytes("POST", f"http://{locs[0]['url']}/{vid},42deadbeef", b"x")
+    assert st == 201
