@@ -155,6 +155,7 @@ def cmd_filer(args):
                 keyspace=conf.get("cassandra.keyspace", "seaweedfs"),
                 username=conf.get("cassandra.username", ""),
                 password=conf.get("cassandra.password", ""),
+                port=int(conf.get("cassandra.port", 9042)),
             )
         elif conf.get_bool("mongodb.enabled"):
             from .filer.sdk_stores import MongoStore
@@ -785,8 +786,14 @@ def cmd_dump_dat(args):
 
     base = volume_file_name(args.dir, args.collection, args.volume_id)
     with open(base + ".dat", "rb") as f:
-        raw = f.read(64)
-        sb = SuperBlock.from_bytes(raw)
+        # two-step read like Volume's loader: the 8-byte header carries
+        # extra_size, which can push the first record past a fixed slice
+        head = f.read(8)
+        import struct as _struct
+
+        extra_size = _struct.unpack(">H", head[6:8])[0] if len(head) == 8 else 0
+        f.seek(0)
+        sb = SuperBlock.from_bytes(f.read(8 + extra_size))
         offset = sb.block_size()
         f.seek(0, 2)
         size = f.tell()

@@ -189,6 +189,11 @@ class AbstractSqlStore(FilerStore):
             ).fetchone()
         return row[0] if row else None
 
+    def kv_delete(self, key: bytes) -> None:
+        with self._lock:
+            self._exec(f"DELETE FROM kv WHERE k={self._ph}", (key,))
+            self._db.commit()
+
     def close(self) -> None:
         with self._lock:
             self._db.close()

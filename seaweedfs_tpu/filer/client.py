@@ -147,3 +147,6 @@ class FilerClient:
             "GET", self.base + "/_kv/" + urllib.parse.quote(key)
         )
         return body if status == 200 else None
+
+    def kv_delete(self, key: str) -> None:
+        http_bytes("DELETE", self.base + "/_kv/" + urllib.parse.quote(key))

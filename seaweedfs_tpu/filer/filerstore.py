@@ -51,6 +51,9 @@ class FilerStore:
     def kv_get(self, key: bytes) -> Optional[bytes]:
         raise NotImplementedError
 
+    def kv_delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
     def close(self) -> None:
         pass
 
@@ -115,6 +118,9 @@ class MemoryStore(FilerStore):
 
     def kv_get(self, key: bytes) -> Optional[bytes]:
         return self._kv.get(key)
+
+    def kv_delete(self, key: bytes) -> None:
+        self._kv.pop(key, None)
 
 
 def __getattr__(name):

@@ -196,5 +196,8 @@ class RedisStore(FilerStore):
     def kv_get(self, key: bytes) -> Optional[bytes]:
         return self._client.execute("GET", KV_PREFIX + key)
 
+    def kv_delete(self, key: bytes) -> None:
+        self._client.execute("DEL", KV_PREFIX + key)
+
     def close(self) -> None:
         self._client.close()

@@ -46,7 +46,7 @@ class CassandraStore(FilerStore):
     (cassandra_store.go:36-57): PRIMARY KEY (directory, name)."""
 
     def __init__(self, hosts: list[str], keyspace: str = "seaweedfs",
-                 username: str = "", password: str = ""):
+                 username: str = "", password: str = "", port: int = 9042):
         try:
             from cassandra.cluster import Cluster  # type: ignore
             from cassandra.auth import PlainTextAuthProvider  # type: ignore
@@ -59,7 +59,7 @@ class CassandraStore(FilerStore):
             PlainTextAuthProvider(username=username, password=password)
             if username else None
         )
-        self._cluster = Cluster(hosts, auth_provider=auth)
+        self._cluster = Cluster(hosts, port=port, auth_provider=auth)
         self._s = self._cluster.connect(keyspace)
         self._s.execute(
             "CREATE TABLE IF NOT EXISTS filemeta (directory varchar, "
@@ -124,6 +124,9 @@ class CassandraStore(FilerStore):
             "SELECT value FROM key_value WHERE key=%s", (key,)
         ).one()
         return bytes(row.value) if row else None
+
+    def kv_delete(self, key: bytes) -> None:
+        self._s.execute("DELETE FROM key_value WHERE key=%s", (key,))
 
     def close(self) -> None:
         self._cluster.shutdown()
@@ -199,6 +202,9 @@ class MongoStore(FilerStore):
     def kv_get(self, key: bytes) -> Optional[bytes]:
         doc = self._kv.find_one({"_id": key})
         return bytes(doc["value"]) if doc else None
+
+    def kv_delete(self, key: bytes) -> None:
+        self._kv.delete_one({"_id": key})
 
     def close(self) -> None:
         self._client.close()
@@ -283,6 +289,9 @@ class EtcdStore(FilerStore):
     def kv_get(self, key: bytes) -> Optional[bytes]:
         raw, _ = self._c.get(self._p + "kv." + key.hex())
         return raw
+
+    def kv_delete(self, key: bytes) -> None:
+        self._c.delete(self._p + "kv." + key.hex())
 
     def close(self) -> None:
         self._c.close()
@@ -385,6 +394,12 @@ class ElasticStore(FilerStore):
         except self._not_found:  # outages propagate; only misses are None
             return None
         return bytes.fromhex(doc["_source"]["value"])
+
+    def kv_delete(self, key: bytes) -> None:
+        try:
+            self._c.delete(index=self._index + "_kv", id=key.hex())
+        except self._not_found:
+            pass
 
     def close(self) -> None:
         self._c.close()

@@ -72,17 +72,24 @@ class TopicPartition:
     def close(self):
         self.buffer.close()
 
+    def discard(self):
+        """Drop pending data without persisting (topic deletion)."""
+        self.buffer.discard()
+
 
 class TopicManager:
     def __init__(self, filer_url: str):
         self.client = FilerClient(filer_url)
         self._partitions: dict[tuple, TopicPartition] = {}
+        self._dead: set[tuple[str, str]] = set()  # tombstones until recreate
         self._lock = threading.Lock()
 
     def conf_path(self, ns: str, topic: str) -> str:
         return f"{TOPICS_ROOT}/{ns}/{topic}/.conf"
 
     def create_topic(self, ns: str, topic: str, partitions: int = 4) -> dict:
+        with self._lock:
+            self._dead.discard((ns, topic))  # explicit recreate revives it
         conf = {"extended": {"partitions": str(partitions)}}
         self.client.create_entry(self.conf_path(ns, topic), conf)
         return {"namespace": ns, "topic": topic, "partitions": partitions}
@@ -98,24 +105,39 @@ class TopicManager:
         }
 
     def delete_topic(self, ns: str, topic: str) -> dict:
-        """DeleteTopic rpc analog (messaging.proto): drop the topic's log
-        tree + conf from the filer and evict live partitions. The filer
-        delete happens INSIDE the lock so a concurrent publish can't slip a
-        fresh partition in between eviction and tree removal; get_partition
-        re-checks the conf before creating, so post-delete publishes fail
-        with 'no such topic' instead of resurrecting orphan log files."""
+        """DeleteTopic rpc analog (messaging.proto): evict live partitions
+        (discarding un-flushed data and JOINING in-flight flush threads — a
+        late flush would resurrect the tree as orphan segments), tombstone
+        the topic so concurrent publishes can't recreate a partition, then
+        drop the log tree + conf from the filer. Filer I/O happens OUTSIDE
+        the lock so a slow delete never stalls other topics' pub/sub."""
         with self._lock:
-            for key in [k for k in self._partitions if k[0] == ns and k[1] == topic]:
-                self._partitions.pop(key).close()
-            self.client.delete(f"{TOPICS_ROOT}/{ns}/{topic}", recursive=True)
+            self._dead.add((ns, topic))
+            doomed = [
+                self._partitions.pop(k)
+                for k in [k for k in self._partitions
+                          if k[0] == ns and k[1] == topic]
+            ]
+        for tp in doomed:
+            tp.discard()
+        self.client.delete(f"{TOPICS_ROOT}/{ns}/{topic}", recursive=True)
         return {"namespace": ns, "topic": topic, "deleted": True}
 
     def get_partition(self, ns: str, topic: str, partition: int) -> TopicPartition:
         key = (ns, topic, partition)
         with self._lock:
             tp = self._partitions.get(key)
+            if tp is not None:
+                return tp
+            if (ns, topic) in self._dead:
+                raise KeyError(f"no such topic {ns}/{topic}")
+        # conf lookup = filer HTTP; never hold the global lock across it
+        if self.topic_conf(ns, topic) is None:
+            raise KeyError(f"no such topic {ns}/{topic}")
+        with self._lock:
+            tp = self._partitions.get(key)
             if tp is None:
-                if self.topic_conf(ns, topic) is None:
+                if (ns, topic) in self._dead:  # deleted while we looked
                     raise KeyError(f"no such topic {ns}/{topic}")
                 tp = TopicPartition(self.client, ns, topic, partition)
                 self._partitions[key] = tp

@@ -23,11 +23,15 @@ class MessagingClient:
 
     # -- topic admin ---------------------------------------------------------
     def create_topic(self, ns: str, topic: str, partitions: int = 4) -> dict:
-        b = self.brokers[0]
-        return http_json(
-            "POST",
-            f"http://{b}/topics/{ns}/{topic}?partitions={partitions}",
-        )
+        # every broker: creation clears any delete-tombstone a broker holds
+        # for this topic (deletes fan out the same way)
+        out = {}
+        for b in self.brokers:
+            out = http_json(
+                "POST",
+                f"http://{b}/topics/{ns}/{topic}?partitions={partitions}",
+            )
+        return out
 
     def topic_conf(self, ns: str, topic: str) -> dict:
         return http_json("GET", f"http://{self.brokers[0]}/topics/{ns}/{topic}")

@@ -61,6 +61,7 @@ class LogBuffer:
         self._start_ts = 0
         self._prev: list[list[tuple[int, bytes, bytes]]] = []
         self._lock = threading.Lock()
+        self._flushers: list[threading.Thread] = []
         self._last_flush = time.monotonic()
         self._stop = threading.Event()
         self._ticker = threading.Thread(target=self._tick, daemon=True)
@@ -90,9 +91,12 @@ class LogBuffer:
         self._msgs, self._buf = [], bytearray()
         self._last_flush = time.monotonic()
         if self.flush_fn:
-            threading.Thread(
+            t = threading.Thread(
                 target=self.flush_fn, args=(start, stop, blob), daemon=True
-            ).start()
+            )
+            self._flushers = [f for f in self._flushers if f.is_alive()]
+            self._flushers.append(t)
+            t.start()
 
     def flush(self) -> None:
         with self._lock:
@@ -130,3 +134,16 @@ class LogBuffer:
     def close(self) -> None:
         self._stop.set()
         self.flush()
+
+    def discard(self) -> None:
+        """Stop WITHOUT persisting: drop pending messages and wait out any
+        in-flight flush threads. For topic deletion — a flush landing after
+        the topic tree is removed would resurrect it as orphan segments."""
+        self._stop.set()
+        with self._lock:
+            self._msgs, self._buf = [], bytearray()
+            self._prev = []
+            flushers = list(self._flushers)
+            self._flushers = []
+        for t in flushers:
+            t.join(timeout=10)

@@ -225,6 +225,9 @@ class FilerServer:
         if h.command == "PUT" or h.command == "POST":
             self.filer.store.kv_put(key, body)
             return 200, {"ok": True}
+        if h.command == "DELETE":  # KvDelete rpc (filer.proto)
+            self.filer.store.kv_delete(key)
+            return 200, {"ok": True}
         v = self.filer.store.kv_get(key)
         if v is None:
             return 404, {"error": "not found"}
@@ -636,6 +639,7 @@ class FilerServer:
                 ("GET", "/_kv/", fs._h_kv),
                 ("PUT", "/_kv/", fs._h_kv),
                 ("POST", "/_kv/", fs._h_kv),
+                ("DELETE", "/_kv/", fs._h_kv),
                 ("GET", "/", fs._h_read),
                 ("HEAD", "/", fs._h_head),
                 ("POST", "/", fs._h_write),

@@ -50,3 +50,11 @@ def _fresh_http_pool():
             except Exception:
                 pass
         conns.clear()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "integration: needs live external daemons "
+        "(other/docker-compose.integration.yml); skips cleanly otherwise",
+    )

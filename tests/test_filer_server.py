@@ -124,3 +124,17 @@ def test_empty_file(cluster):
     assert status == 201
     status, data = http_bytes("GET", f"http://{filer.url}/empty.txt")
     assert status == 200 and data == b""
+
+
+def test_kv_put_get_delete_http(cluster):
+    """The filer KV surface (filer.proto KvPut/KvGet/KvDelete) over HTTP,
+    via the FilerClient the gateways use."""
+    from seaweedfs_tpu.filer.client import FilerClient
+
+    _, _, filer = cluster
+    fc = FilerClient(filer.url)
+    fc.kv_put("sync/offset-a", b"\x00\x07")
+    assert fc.kv_get("sync/offset-a") == b"\x00\x07"
+    fc.kv_delete("sync/offset-a")
+    assert fc.kv_get("sync/offset-a") is None
+    fc.kv_delete("sync/never-existed")  # no-op, not an error

@@ -118,6 +118,9 @@ def test_contract_crud_listing_kv(store):
     store.kv_put(b"offset", b"\x00\x01\x02")
     assert store.kv_get(b"offset") == b"\x00\x01\x02"
     assert store.kv_get(b"missing") is None
+    store.kv_delete(b"offset")
+    assert store.kv_get(b"offset") is None
+    store.kv_delete(b"missing")  # no-op on absent keys
 
 
 def test_contract_deep_paging(store):

@@ -178,3 +178,26 @@ def test_delete_topic_drops_log_and_conf(stack):
     fc = FilerClient(filer.url)
     assert fc.get_entry("/topics/tmp/doomed/.conf") is None
     assert fc.list("/topics/tmp/doomed", limit=10) == []
+
+
+def test_delete_topic_under_write_no_resurrection(stack):
+    """Deleting immediately after publishes (un-flushed buffer, in-flight
+    flush threads) must not resurrect the topic tree as orphan segments,
+    and recreating after delete must work."""
+    brokers, filer = stack
+    from seaweedfs_tpu.filer.client import FilerClient
+
+    mc = MessagingClient([b.url for b in brokers])
+    fc = FilerClient(filer.url)
+    for round_ in range(3):
+        mc.create_topic("r", "hot", partitions=1)
+        for i in range(30):
+            mc.publish("r", "hot", f"m{i}".encode(), partition=0)
+        assert mc.delete_topic("r", "hot")["deleted"] is True
+        time.sleep(0.3)  # a leaked flush would land in this window
+        assert fc.get_entry("/topics/r/hot/.conf") is None, round_
+        assert fc.list("/topics/r/hot", limit=10) == [], round_
+    mc.create_topic("r", "hot", partitions=1)
+    mc.publish("r", "hot", b"reborn", partition=0)
+    msgs, _ = mc.fetch("r", "hot", 0)
+    assert any(m["value"] == b"reborn" for m in msgs)
