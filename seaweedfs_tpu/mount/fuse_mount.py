@@ -127,6 +127,16 @@ _FGETATTR = ctypes.CFUNCTYPE(
     c_int, c_char_p, ctypes.POINTER(Stat), ctypes.POINTER(FuseFileInfo)
 )
 _UTIMENS = ctypes.CFUNCTYPE(c_int, c_char_p, ctypes.POINTER(Timespec * 2))
+_SETXATTR = ctypes.CFUNCTYPE(
+    c_int, c_char_p, c_char_p, ctypes.POINTER(ctypes.c_char), c_size_t, c_int
+)
+_GETXATTR = ctypes.CFUNCTYPE(
+    c_int, c_char_p, c_char_p, ctypes.POINTER(ctypes.c_char), c_size_t
+)
+_LISTXATTR = ctypes.CFUNCTYPE(
+    c_int, c_char_p, ctypes.POINTER(ctypes.c_char), c_size_t
+)
+_REMOVEXATTR = ctypes.CFUNCTYPE(c_int, c_char_p, c_char_p)
 
 
 class FuseOperations(ctypes.Structure):
@@ -154,10 +164,10 @@ class FuseOperations(ctypes.Structure):
         ("flush", _FLUSH),
         ("release", _RELEASE),
         ("fsync", _FSYNC),
-        ("setxattr", c_void_p),
-        ("getxattr", c_void_p),
-        ("listxattr", c_void_p),
-        ("removexattr", c_void_p),
+        ("setxattr", _SETXATTR),
+        ("getxattr", _GETXATTR),
+        ("listxattr", _LISTXATTR),
+        ("removexattr", _REMOVEXATTR),
         ("opendir", c_void_p),
         ("readdir", _READDIR),
         ("releasedir", c_void_p),
@@ -248,9 +258,7 @@ class FuseMount:
 
     def _commit_entry(self, path: str, entry) -> None:
         """Persist changed metadata (filer create is an upsert)."""
-        self.wfs.client.create_entry(path, entry.to_dict())
-        if self.wfs.meta_cache:
-            self.wfs.meta_cache.invalidate(path)
+        self.wfs._commit_meta(path, entry)
 
     # -- op table -------------------------------------------------------------
     def _build_ops(self) -> FuseOperations:
@@ -469,7 +477,55 @@ class FuseMount:
             self._commit_entry(p, entry)
             return 0
 
+        @guard
+        def op_setxattr(path, name, value, size, flags):
+            p = self._fp(path)
+            data = ctypes.string_at(value, size) if size else b""
+            self.wfs.setxattr(
+                p, name.decode(), data,
+                create=flags == 1, replace=flags == 2,  # XATTR_CREATE/REPLACE
+            )
+            return 0
+
+        @guard
+        def op_getxattr(path, name, buf, size):
+            if name == b"security.capability":
+                # the kernel probes this before EVERY write; never stored
+                # here (file capabilities on a network mount are not a
+                # thing), so answer without a filer lookup
+                return -errno.ENODATA
+            p = self._fp(path)
+            raw = self.wfs.getxattr(p, name.decode())
+            if size == 0:
+                return len(raw)  # probe call: report needed length
+            if size < len(raw):
+                return -errno.ERANGE
+            ctypes.memmove(buf, raw, len(raw))
+            return len(raw)
+
+        @guard
+        def op_listxattr(path, buf, size):
+            p = self._fp(path)
+            blob = b"".join(
+                n.encode() + b"\x00" for n in self.wfs.listxattr(p)
+            )
+            if size == 0:
+                return len(blob)
+            if size < len(blob):
+                return -errno.ERANGE
+            ctypes.memmove(buf, blob, len(blob))
+            return len(blob)
+
+        @guard
+        def op_removexattr(path, name):
+            self.wfs.removexattr(self._fp(path), name.decode())
+            return 0
+
         ops = FuseOperations()
+        ops.setxattr = _SETXATTR(op_setxattr)
+        ops.getxattr = _GETXATTR(op_getxattr)
+        ops.listxattr = _LISTXATTR(op_listxattr)
+        ops.removexattr = _REMOVEXATTR(op_removexattr)
         ops.getattr = _GETATTR(op_getattr)
         ops.mkdir = _MKDIR(op_mkdir)
         ops.unlink = _UNLINK(op_unlink)

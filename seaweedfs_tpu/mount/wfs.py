@@ -110,6 +110,64 @@ class WFS:
             self.meta_cache.invalidate(old)
             self.meta_cache.invalidate(new)
 
+    # -- xattr (filesys/xattr.go — entry.Extended carries them) --------------
+    XATTR_PREFIX = "xattr-"
+
+    def _commit_meta(self, path: str, entry: Entry) -> None:
+        self.client.create_entry(path, entry.to_dict())
+        if self.meta_cache:
+            self.meta_cache.invalidate(path)
+
+    def setxattr(self, path: str, name: str, value: bytes,
+                 create: bool = False, replace: bool = False) -> None:
+        import base64
+        import errno
+
+        # always the LIVE entry, never a cache: a concurrent FileHandle
+        # flush may have just committed fresh chunks, and upserting a
+        # stale chunk list here would truncate the file's new data
+        entry = self._remote_entry(path)
+        if entry is None:
+            raise FileNotFoundError(path)
+        ext = dict(entry.extended or {})
+        key = self.XATTR_PREFIX + name
+        if create and key in ext:
+            raise FileExistsError(name)
+        if replace and key not in ext:
+            raise OSError(errno.ENODATA, name)
+        ext[key] = base64.b64encode(value).decode()
+        entry.extended = ext
+        self._commit_meta(path, entry)
+
+    def getxattr(self, path: str, name: str) -> bytes:
+        import base64
+        import errno
+
+        entry = self.stat(path)
+        raw = (entry.extended or {}).get(self.XATTR_PREFIX + name)
+        if raw is None:
+            raise OSError(errno.ENODATA, name)
+        return base64.b64decode(raw)
+
+    def listxattr(self, path: str) -> list[str]:
+        entry = self.stat(path)
+        pre = self.XATTR_PREFIX
+        return sorted(
+            k[len(pre):] for k in (entry.extended or {}) if k.startswith(pre)
+        )
+
+    def removexattr(self, path: str, name: str) -> None:
+        import errno
+
+        entry = self._remote_entry(path)  # live, not cached (see setxattr)
+        if entry is None:
+            raise FileNotFoundError(path)
+        ext = dict(entry.extended or {})
+        if ext.pop(self.XATTR_PREFIX + name, None) is None:
+            raise OSError(errno.ENODATA, name)
+        entry.extended = ext
+        self._commit_meta(path, entry)
+
     # -- file ops ------------------------------------------------------------
     def open(self, path: str, mode: str = "r") -> "FileHandle":
         """Modes: r, r+, w (truncate/create), a (append/create)."""
@@ -223,9 +281,13 @@ class FileHandle:
     def _commit_chunks(self, new_chunks: list[FileChunk]) -> None:
         self.entry.chunks.extend(new_chunks)
         self.entry.mtime = int(time.time())
-        self.wfs.client.create_entry(self.path, self.entry.to_dict())
-        if self.wfs.meta_cache:
-            self.wfs.meta_cache.invalidate(self.path)
+        # take the LIVE extended map before upserting: an xattr set (or
+        # removed) while this handle was open must not be clobbered by the
+        # open-time snapshot — the handle itself never mutates extended
+        remote = self.wfs._remote_entry(self.path)
+        if remote is not None:
+            self.entry.extended = dict(remote.extended or {})
+        self.wfs._commit_meta(self.path, self.entry)
 
     def flush(self) -> None:
         with self._lock:

@@ -159,3 +159,29 @@ def test_mount_subtree_root(tmp_path):
         fs.stop()
         vs.stop()
         ms.stop()
+
+
+def test_xattr_through_the_kernel(mounted):
+    """setfattr/getfattr semantics via os.*xattr against the kernel mount
+    (filesys/xattr.go analog: xattrs ride the entry's extended map)."""
+    mp = mounted
+    path = os.path.join(mp, "tagged.txt")
+    with open(path, "wb") as f:
+        f.write(b"payload")
+    os.setxattr(path, "user.color", b"indigo")
+    os.setxattr(path, "user.bin", bytes(range(16)))
+    assert os.getxattr(path, "user.color") == b"indigo"
+    assert os.getxattr(path, "user.bin") == bytes(range(16))
+    assert sorted(os.listxattr(path)) == ["user.bin", "user.color"]
+    # XATTR_CREATE on an existing name must fail
+    with pytest.raises(OSError):
+        os.setxattr(path, "user.color", b"x", os.XATTR_CREATE)
+    # XATTR_REPLACE on a missing name must fail
+    with pytest.raises(OSError):
+        os.setxattr(path, "user.ghost", b"x", os.XATTR_REPLACE)
+    os.removexattr(path, "user.color")
+    assert os.listxattr(path) == ["user.bin"]
+    with pytest.raises(OSError):
+        os.getxattr(path, "user.color")
+    # xattrs survive the round trip through the filer (fresh stat)
+    assert os.getxattr(path, "user.bin") == bytes(range(16))

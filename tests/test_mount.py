@@ -289,3 +289,60 @@ def test_mount_sync_same_size_update_and_create_delete_race(stack, tmp_path):
     finally:
         ms.stop()
         wfs.close()
+
+
+def test_wfs_xattr_lifecycle(stack):
+    """WFS xattr API (the FUSE callbacks' backing): set/get/list/remove with
+    CREATE/REPLACE semantics, values binary-safe through the filer."""
+    import errno
+
+    from seaweedfs_tpu.mount.wfs import WFS
+
+    wfs = WFS(stack.url, use_meta_cache=False)
+    wfs.write_file("/xa/f.bin", b"data")
+    wfs.setxattr("/xa/f.bin", "user.color", b"indigo")
+    wfs.setxattr("/xa/f.bin", "user.bin", bytes(range(256)))
+    assert wfs.getxattr("/xa/f.bin", "user.color") == b"indigo"
+    assert wfs.getxattr("/xa/f.bin", "user.bin") == bytes(range(256))
+    assert wfs.listxattr("/xa/f.bin") == ["user.bin", "user.color"]
+    try:
+        wfs.setxattr("/xa/f.bin", "user.color", b"x", create=True)
+        raise AssertionError("XATTR_CREATE over existing must fail")
+    except FileExistsError:
+        pass
+    try:
+        wfs.setxattr("/xa/f.bin", "user.ghost", b"x", replace=True)
+        raise AssertionError("XATTR_REPLACE over missing must fail")
+    except OSError as e:
+        assert e.errno == errno.ENODATA
+    wfs.removexattr("/xa/f.bin", "user.color")
+    assert wfs.listxattr("/xa/f.bin") == ["user.bin"]
+    # file content untouched by metadata-only commits
+    assert wfs.read_file("/xa/f.bin") == b"data"
+    wfs.close()
+
+
+def test_xattr_survives_open_handle_commits(stack):
+    """An xattr set while a FileHandle is open must survive the handle's
+    chunk commits, and a setxattr must not clobber freshly flushed chunks."""
+    from seaweedfs_tpu.mount.wfs import WFS
+
+    wfs = WFS(stack.url, use_meta_cache=False)
+    wfs.write_file("/xa/race.bin", b"v1")
+    with wfs.open("/xa/race.bin", "r+") as fh:
+        wfs.setxattr("/xa/race.bin", "user.live", b"set-while-open")
+        fh.write(0, b"v2-longer-content")
+        fh.flush()
+        # the flush's entry upsert must carry the live xattr
+        assert wfs.getxattr("/xa/race.bin", "user.live") == b"set-while-open"
+        # and a second xattr write must not truncate the flushed data
+        wfs.setxattr("/xa/race.bin", "user.more", b"x")
+    assert wfs.read_file("/xa/race.bin") == b"v2-longer-content"
+    assert wfs.getxattr("/xa/race.bin", "user.live") == b"set-while-open"
+    # removal while open is not resurrected by the close-time commit
+    with wfs.open("/xa/race.bin", "r+") as fh:
+        fh.write(0, b"v3")
+        wfs.removexattr("/xa/race.bin", "user.more")
+    assert "user.more" not in wfs.listxattr("/xa/race.bin")
+    assert wfs.read_file("/xa/race.bin") == b"v3-longer-content"
+    wfs.close()
