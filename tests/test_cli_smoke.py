@@ -179,3 +179,45 @@ def test_one_shot_admin_shell(stack):
     assert out.returncode == 0, out.stderr
     assert f"127.0.0.1:{ports['volume']}" in out.stdout  # topology lists it
     assert "smoke" in out.stdout  # bucket.list sees the s3-created bucket
+
+
+def test_allinone_server_subcommand(tmp_path):
+    """`weed server -filer -s3 -webdav`: the reference's one-process stack
+    (command/server.go:119) — write via filer, read via WebDAV, list via S3."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    p = {k: free_port() for k in ("m", "v", "f", "s3", "dav")}
+    (tmp_path / "data").mkdir()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "server",
+         "-dir", "data",
+         "-master.port", str(p["m"]), "-port", str(p["v"]),
+         "-filer", "-filer.port", str(p["f"]),
+         "-s3", "-s3.port", str(p["s3"]),
+         "-webdav", "-webdav.port", str(p["dav"])],
+        env=env, cwd=str(tmp_path),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        _wait_http(f"http://127.0.0.1:{p['f']}/_status")
+        _wait_port(p["s3"])
+        _wait_port(p["dav"])
+        # write through the filer
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{p['f']}/one/hello.txt", data=b"one process",
+            method="POST",
+        )
+        assert urllib.request.urlopen(req, timeout=10).status == 201
+        # read through WebDAV (same namespace)
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{p['dav']}/one/hello.txt", timeout=10
+        )
+        assert r.read() == b"one process"
+        # S3 sees the service (anonymous list of buckets root)
+        r = urllib.request.urlopen(f"http://127.0.0.1:{p['s3']}/", timeout=10)
+        assert r.status == 200
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()

@@ -304,3 +304,31 @@ def test_move_respects_child_locks_and_releases_source_locks(webdav):
     assert st == 201
     st, _, _ = dav("PUT", f"{base}/mv2/inner.txt", b"new")  # new URL unlocked
     assert st == 204
+
+
+def test_concurrent_exclusive_locks_one_winner(webdav):
+    """N simultaneous LOCKs on one resource: exactly one 200/201, the rest
+    423 (the conflict check and insert share one critical section)."""
+    import threading
+
+    base = f"http://{webdav.url}"
+    dav("PUT", f"{base}/contended.txt", b"x")
+    results = []
+    barrier = threading.Barrier(8)
+
+    def try_lock():
+        barrier.wait()
+        st, _, h = dav("LOCK", f"{base}/contended.txt", LOCKINFO)
+        results.append((st, h.get("Lock-Token", "")))
+
+    threads = [threading.Thread(target=try_lock) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    winners = [r for r in results if r[0] in (200, 201)]
+    losers = [r for r in results if r[0] == 423]
+    assert len(winners) == 1, results
+    assert len(losers) == 7, results
+    dav("UNLOCK", f"{base}/contended.txt", b"",
+        {"Lock-Token": f"<{winners[0][1].strip('<>')}>"})
