@@ -156,14 +156,17 @@ def cmd_server(args):
 
 def _filer_store_from_conf(db_path: str):
     """filer.toml store selection (first enabled store wins); an explicit
-    -db beats the config file. Returns (db_path, store). Shared by the
-    standalone `filer` command and `server -filer` so the one-process stack
-    honors the same configuration."""
+    -db beats the config file, and an UNSET -db with no config lands on a
+    persistent ./filer.db — the reference's filer defaults to a durable
+    store (leveldb2), so metadata surviving a restart is the baseline
+    expectation; `-db :memory:` opts into the ephemeral store explicitly.
+    Returns (db_path, store). Shared by the standalone `filer` command and
+    `server -filer` so the one-process stack honors the same config."""
     from .util.config import load_configuration
 
     store = None
     conf = load_configuration("filer")
-    if db_path == ":memory:":
+    if not db_path:
         if conf.get_bool("redis.enabled"):
             from .filer.redis_store import RedisStore
 
@@ -221,6 +224,21 @@ def _filer_store_from_conf(db_path: str):
             )
         elif conf.get_bool("sqlite.enabled"):
             db_path = conf.get("sqlite.dbFile", "./filer.db")
+        if store is None and not db_path:
+            # durable default, like the reference — but a bare `weed filer`
+            # must still come up in a read-only cwd (containers), so fall
+            # back to the ephemeral store with a loud warning rather than
+            # crashing on sqlite open
+            db_path = "./filer.db"
+            if not os.access(os.path.dirname(os.path.abspath(db_path)),
+                             os.W_OK):
+                print(
+                    "WARNING: cwd not writable; filer metadata is "
+                    "IN-MEMORY and will not survive a restart "
+                    "(pass -db or mount a writable dir)",
+                    file=sys.stderr,
+                )
+                db_path = ":memory:"
     return db_path, store
 
 
@@ -1156,7 +1174,11 @@ def main(argv=None):
     s.add_argument("-filer", action="store_true",
                    help="also run a filer (command/server.go -filer)")
     s.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
-    s.add_argument("-filer.db", dest="filer_db", default=":memory:")
+    s.add_argument(
+        "-filer.db", dest="filer_db", default="",
+        help="sqlite path (default ./filer.db; ':memory:' for ephemeral; "
+             "filer.toml stores win when unset — same as `filer -db`)",
+    )
     s.add_argument("-s3", action="store_true",
                    help="also run the S3 gateway (implies -filer)")
     s.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
@@ -1172,7 +1194,11 @@ def main(argv=None):
     f.add_argument("-port", type=int, default=8888)
     f.add_argument("-master", default="127.0.0.1:9333")
     f.add_argument("-chunkSizeMB", dest="chunk_size_mb", type=int, default=32)
-    f.add_argument("-db", default=":memory:")
+    f.add_argument(
+        "-db", default="",
+        help="sqlite path (default ./filer.db; ':memory:' for ephemeral; "
+             "filer.toml stores win when -db is unset)",
+    )
     f.add_argument("-collection", default="")
     f.add_argument("-replication", default="")
     f.add_argument(

@@ -52,6 +52,27 @@ def _wait_port(port, timeout=15.0):
     raise TimeoutError(f"port {port}")
 
 
+
+def _spawn(cwd, *args):
+    """One CLI daemon subprocess, repo on PYTHONPATH, quiet."""
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        env=dict(os.environ, PYTHONPATH=REPO), cwd=str(cwd),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _terminate(*procs):
+    for proc in procs:
+        if proc is None:
+            continue
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 @pytest.fixture(scope="module")
 def stack(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("cli")
@@ -66,11 +87,7 @@ def stack(tmp_path_factory):
     }]}))
 
     def spawn(*args):
-        return subprocess.Popen(
-            [sys.executable, "-m", "seaweedfs_tpu", *args],
-            env=env, cwd=str(tmp),
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        )
+        return _spawn(tmp, *args)
 
     procs = [spawn("master", "-port", str(ports["master"]))]
     _wait_http(f"http://127.0.0.1:{ports['master']}/cluster/status")
@@ -184,18 +201,14 @@ def test_one_shot_admin_shell(stack):
 def test_allinone_server_subcommand(tmp_path):
     """`weed server -filer -s3 -webdav`: the reference's one-process stack
     (command/server.go:119) — write via filer, read via WebDAV, list via S3."""
-    env = dict(os.environ, PYTHONPATH=REPO)
     p = {k: free_port() for k in ("m", "v", "f", "s3", "dav")}
     (tmp_path / "data").mkdir()
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "seaweedfs_tpu", "server",
-         "-dir", "data",
-         "-master.port", str(p["m"]), "-port", str(p["v"]),
-         "-filer", "-filer.port", str(p["f"]),
-         "-s3", "-s3.port", str(p["s3"]),
-         "-webdav", "-webdav.port", str(p["dav"])],
-        env=env, cwd=str(tmp_path),
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    proc = _spawn(
+        tmp_path, "server", "-dir", "data",
+        "-master.port", str(p["m"]), "-port", str(p["v"]),
+        "-filer", "-filer.port", str(p["f"]),
+        "-s3", "-s3.port", str(p["s3"]),
+        "-webdav", "-webdav.port", str(p["dav"]),
     )
     try:
         _wait_http(f"http://127.0.0.1:{p['f']}/_status")
@@ -216,8 +229,44 @@ def test_allinone_server_subcommand(tmp_path):
         r = urllib.request.urlopen(f"http://127.0.0.1:{p['s3']}/", timeout=10)
         assert r.status == 200
     finally:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+        _terminate(proc)
+
+
+def test_filer_metadata_survives_restart(tmp_path):
+    """The filer's DEFAULT store is durable (the reference defaults to a
+    persistent leveldb): metadata written before a kill is served after a
+    restart with no flags."""
+    mp, vp, fp_ = free_port(), free_port(), free_port()
+    (tmp_path / "vol").mkdir()
+
+    def spawn(*args):
+        return _spawn(tmp_path, *args)
+
+    master = spawn("master", "-port", str(mp))
+
+    volume = filer = None
+    try:
+        _wait_http(f"http://127.0.0.1:{mp}/cluster/status")
+        volume = spawn("volume", "-dir", "vol", "-port", str(vp),
+                       "-mserver", f"127.0.0.1:{mp}", "-pulseSeconds", "1")
+        _wait_http(f"http://127.0.0.1:{vp}/status")
+        filer = spawn("filer", "-port", str(fp_),
+                      "-master", f"127.0.0.1:{mp}")
+        _wait_http(f"http://127.0.0.1:{fp_}/_status")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fp_}/keep/me.txt", data=b"durable",
+            method="POST",
+        )
+        assert urllib.request.urlopen(req, timeout=10).status == 201
+        filer.send_signal(signal.SIGKILL)
+        filer.wait(timeout=10)
+        assert (tmp_path / "filer.db").exists()
+        filer = spawn("filer", "-port", str(fp_),
+                      "-master", f"127.0.0.1:{mp}")
+        _wait_http(f"http://127.0.0.1:{fp_}/_status")
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{fp_}/keep/me.txt", timeout=10
+        )
+        assert r.read() == b"durable"
+    finally:
+        _terminate(filer, volume, master)
