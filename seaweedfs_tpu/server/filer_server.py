@@ -95,7 +95,10 @@ class FilerServer:
             "filer_request_seconds", "filer request latency"
         )
         self.host, self.port = host, port
-        self.master_url = master_url
+        # comma-separated master seeds (HA: filer.go takes -master lists);
+        # operation calls go to the live leader via master_url (property)
+        self.master_seeds = [m.strip() for m in master_url.split(",")
+                             if m.strip()]
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
@@ -124,7 +127,9 @@ class FilerServer:
         # wdclient keeps the vid map warm off the master's KeepConnected
         # feed (wdclient/masterclient.go); hot-path reads never block on a
         # master round-trip unless the vid is genuinely unknown
-        self._master_client = MasterClient(master_url, f"filer@{host}:{port}").start()
+        self._master_client = MasterClient(
+            self.master_seeds, f"filer@{host}:{port}"
+        ).start()
         self._lookup = _VidLookup(self._master_client)
         self._load_filer_conf()
         self._srv = None
@@ -141,6 +146,27 @@ class FilerServer:
         self.meta_aggregator = MetaAggregator(
             self.filer, f"{host}:{port}", peers or []
         )
+
+    @property
+    def master_url(self) -> str:
+        """The master to talk to RIGHT NOW: the leader the KeepConnected
+        feed discovered, else a briefly-cached probe through the seeds — a
+        filer must survive its first-listed master dying (wdclient
+        masterclient.go tryAllMasters), including the startup/blip windows
+        where the background loop hasn't re-discovered a leader yet."""
+        mc = getattr(self, "_master_client", None)
+        if mc is not None and mc.current_master:
+            return mc.current_master
+        if len(self.master_seeds) == 1:
+            return self.master_seeds[0]
+        from ..wdclient import find_reachable_master
+
+        now = time.monotonic()
+        cached = getattr(self, "_seed_pick_", None)
+        if cached is None or now - cached[1] > 2.0:
+            cached = (find_reachable_master(self.master_seeds, 1.0), now)
+            self._seed_pick_ = cached
+        return cached[0]
 
     def _load_filer_conf(self) -> None:
         """Read /etc/seaweedfs/filer.conf through the filer and swap the

@@ -27,6 +27,18 @@ class CommandEnv:
     filer: str = ""  # filer url for fs.* / bucket.* / fsck commands
     cwd: str = "/"  # fs.* working directory (command_fs_cd.go)
 
+    def __post_init__(self):
+        # -master accepts a comma list (shell.go ShellOptions.Masters);
+        # pin to a VERIFIED-reachable seed — followers proxy leader-only
+        # ops, while a reported "leader" may itself be freshly dead
+        from ..wdclient import find_reachable_master
+
+        seeds = [m.strip() for m in self.master.split(",") if m.strip()]
+        if seeds:
+            self.master = (
+                seeds[0] if len(seeds) == 1 else find_reachable_master(seeds)
+            )
+
     def lock(self) -> str:
         r = http_json("POST", f"http://{self.master}/cluster/lock?client=shell")
         if r.get("error"):

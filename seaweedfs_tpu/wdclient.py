@@ -87,6 +87,20 @@ class VidMap:
             return len(self._locations)
 
 
+def find_reachable_master(seeds: list[str], timeout: float = 2.0) -> str:
+    """First seed answering /cluster/status. Reachable beats leader-
+    guessing: followers PROXY leader-only ops (master_server._leader_only),
+    while a reported leader may itself be dead — never pin to an address
+    nobody verified. Falls back to the first seed when none answer."""
+    for m in seeds:
+        try:
+            http_json("GET", f"http://{m}/cluster/status", timeout=timeout)
+            return m
+        except Exception:
+            continue
+    return seeds[0] if seeds else ""
+
+
 class MasterClient:
     """Keeps a VidMap fresh by long-polling the master's location feed
     (wdclient/masterclient.go KeepConnectedToMaster); falls back to a

@@ -138,3 +138,52 @@ def test_single_master_is_its_own_leader(tmp_path):
         assert st["is_leader"] is True
     finally:
         m.stop()
+
+
+def test_filer_survives_master_failover(trio, tmp_path):
+    """A filer seeded with the master list keeps serving writes after the
+    leader (its first-listed master) dies — assigns fail over through the
+    wdclient leader discovery (filer.go -master lists)."""
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.http_util import http_bytes
+
+    urls, masters, vs = trio
+    leader = wait_for(lambda: leader_of(urls[0]))
+    assert leader
+    # leader FIRST in the seed list: its death must not strand the filer
+    seeds = [leader] + [u for u in urls if u != leader]
+    filer = FilerServer(
+        port=free_port(), master_url=",".join(seeds)
+    ).start()
+    try:
+        st, _ = http_bytes("POST", f"http://{filer.url}/ha/pre.txt", b"before")
+        assert st == 201
+        masters[urls.index(leader)].stop()
+        new_leader = wait_for(
+            lambda: next(
+                (l for l in (leader_of(u) for u in urls if u != leader)
+                 if l and l != leader),
+                None,
+            ),
+            timeout=15,
+        )
+        assert new_leader, "no new leader elected"
+        # volume server re-registers with the new leader; then the filer
+        # must assign + write through it
+        deadline = time.time() + 20
+        ok = False
+        while time.time() < deadline:
+            st, _ = http_bytes(
+                "POST", f"http://{filer.url}/ha/post.txt", b"after failover"
+            )
+            if st == 201:
+                ok = True
+                break
+            time.sleep(0.5)
+        assert ok, "filer never recovered after leader death"
+        st, data = http_bytes("GET", f"http://{filer.url}/ha/post.txt")
+        assert (st, data) == (200, b"after failover")
+        st, data = http_bytes("GET", f"http://{filer.url}/ha/pre.txt")
+        assert (st, data) == (200, b"before")
+    finally:
+        filer.stop()
