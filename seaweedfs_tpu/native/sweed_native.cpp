@@ -13,6 +13,9 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#if defined(__AVX2__)
+#include <immintrin.h>  // outside extern "C": intrinsics need C++ linkage
+#endif
 
 namespace {
 
@@ -94,12 +97,43 @@ uint32_t sweed_crc32c_update(uint32_t crc, const uint8_t* data, size_t n) {
 // matrix applies to input row c only when in_present[c] != 0, and matrix
 // columns are indexed by input-slot (so callers pass a full-width matrix with
 // zeros for absent slots or compact inputs — we use compact inputs here).
+#if defined(__AVX2__)
+// One coefficient's contribution over n bytes, 32 at a time: the PSHUFB
+// nibble-table kernel (klauspost's galois_amd64.s formulation — two 16-entry
+// product tables indexed by the low/high nibble of every input byte).
+static inline void mul_xor_avx2(const uint8_t* src, uint8_t* dst, size_t n,
+                                const uint8_t lo[16], const uint8_t hi[16],
+                                bool first) {
+  const __m256i lot =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)lo));
+  const __m256i hit =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)hi));
+  const __m256i mask = _mm256_set1_epi8(0x0F);
+  size_t j = 0;
+  for (; j + 32 <= n; j += 32) {
+    __m256i v = _mm256_loadu_si256((const __m256i*)(src + j));
+    __m256i l = _mm256_and_si256(v, mask);
+    __m256i h = _mm256_and_si256(_mm256_srli_epi64(v, 4), mask);
+    __m256i r = _mm256_xor_si256(_mm256_shuffle_epi8(lot, l),
+                                 _mm256_shuffle_epi8(hit, h));
+    if (!first)
+      r = _mm256_xor_si256(r, _mm256_loadu_si256((const __m256i*)(dst + j)));
+    _mm256_storeu_si256((__m256i*)(dst + j), r);
+  }
+  for (; j < n; j++) {
+    uint8_t v = src[j];
+    uint8_t x = lo[v & 0x0F] ^ hi[v >> 4];
+    dst[j] = first ? x : (uint8_t)(dst[j] ^ x);
+  }
+}
+#endif
+
 void sweed_rs_matmul(const uint8_t* matrix, int out_rows, int kk, size_t n,
                      const uint8_t* in, uint8_t* out) {
   const GfTables& g = gf();
-  // Per (r, c) coefficient, use two 16-entry nibble tables so the inner loop
-  // is table lookups the compiler can unroll (the scalar cousin of klauspost's
-  // PSHUFB kernel).
+  // Per (r, c) coefficient, two 16-entry nibble tables: with AVX2 the inner
+  // loop is klauspost's PSHUFB kernel (32 bytes per shuffle pair); without,
+  // the scalar table-lookup cousin.
   for (int r = 0; r < out_rows; r++) {
     uint8_t* dst = out + static_cast<size_t>(r) * n;
     bool first = true;
@@ -117,6 +151,10 @@ void sweed_rs_matmul(const uint8_t* matrix, int out_rows, int kk, size_t n,
         lo[x] = g.mul(coef, static_cast<uint8_t>(x));
         hi[x] = g.mul(coef, static_cast<uint8_t>(x << 4));
       }
+#if defined(__AVX2__)
+      mul_xor_avx2(src, dst, n, lo, hi, first);
+      first = false;
+#else
       if (first) {
         for (size_t j = 0; j < n; j++) {
           uint8_t v = src[j];
@@ -129,6 +167,7 @@ void sweed_rs_matmul(const uint8_t* matrix, int out_rows, int kk, size_t n,
           dst[j] ^= lo[v & 0x0F] ^ hi[v >> 4];
         }
       }
+#endif
     }
     if (first) std::memset(dst, 0, n);  // all-zero matrix row
   }

@@ -33,11 +33,29 @@ class CommandEnv:
         # ops, while a reported "leader" may itself be freshly dead
         from ..wdclient import find_reachable_master
 
-        seeds = [m.strip() for m in self.master.split(",") if m.strip()]
-        if seeds:
+        self.master_seeds = [
+            m.strip() for m in self.master.split(",") if m.strip()
+        ]
+        if self.master_seeds:
             self.master = (
-                seeds[0] if len(seeds) == 1 else find_reachable_master(seeds)
+                self.master_seeds[0]
+                if len(self.master_seeds) == 1
+                else find_reachable_master(self.master_seeds)
             )
+
+    def re_resolve_master(self) -> bool:
+        """Mid-session failover: pick a (different) reachable seed after a
+        connection failure. True when the pinned master changed."""
+        if len(getattr(self, "master_seeds", [])) <= 1:
+            return False
+        from ..wdclient import find_reachable_master
+
+        others = [m for m in self.master_seeds if m != self.master]
+        new = find_reachable_master(others + [self.master])
+        changed = bool(new) and new != self.master
+        if new:
+            self.master = new
+        return changed
 
     def lock(self) -> str:
         r = http_json("POST", f"http://{self.master}/cluster/lock?client=shell")

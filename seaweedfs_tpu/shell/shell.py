@@ -53,6 +53,20 @@ def _flags(parts: list[str]) -> dict[str, str]:
     return out
 
 
+def run_command_with_failover(env: CommandEnv, line: str) -> object:
+    """run_command, retried ONCE against a re-resolved master when the
+    pinned one refuses connections mid-session (a refused connection means
+    nothing executed, so the retry is safe for every command)."""
+    import urllib.error
+
+    try:
+        return run_command(env, line)
+    except (OSError, urllib.error.URLError):
+        if env.re_resolve_master():
+            return run_command(env, line)
+        raise
+
+
 def run_command(env: CommandEnv, line: str) -> object:
     parts = shlex.split(line.strip())
     if not parts:
@@ -196,7 +210,7 @@ def run_shell(master: str, filer: str = "", command: str = "") -> None:
         try:
             for line in command.split(";"):
                 try:
-                    result = run_command(env, line)
+                    result = run_command_with_failover(env, line)
                 except EOFError:  # 'exit' in a script is a clean stop
                     break
                 except Exception as e:  # noqa: BLE001
@@ -221,7 +235,7 @@ def run_shell(master: str, filer: str = "", command: str = "") -> None:
         except (EOFError, KeyboardInterrupt):
             break
         try:
-            result = run_command(env, line)
+            result = run_command_with_failover(env, line)
         except EOFError:
             break
         except Exception as e:

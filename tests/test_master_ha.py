@@ -187,3 +187,28 @@ def test_filer_survives_master_failover(trio, tmp_path):
         assert (st, data) == (200, b"before")
     finally:
         filer.stop()
+
+
+def test_shell_survives_midsession_leader_death(trio):
+    """A shell session pinned to the leader keeps working after that
+    master dies mid-session: the failover wrapper re-resolves to a
+    surviving seed and retries (shell.go ShellOptions.Masters)."""
+    from seaweedfs_tpu.shell.shell import CommandEnv, run_command_with_failover
+
+    urls, masters, vs = trio
+    leader = wait_for(lambda: leader_of(urls[0]))
+    assert leader
+    env = CommandEnv(",".join([leader] + [u for u in urls if u != leader]))
+    assert run_command_with_failover(env, "cluster.status")
+    masters[urls.index(leader)].stop()
+    # next command: first attempt hits the dead master, wrapper re-resolves
+    deadline = time.time() + 20
+    out = None
+    while time.time() < deadline:
+        try:
+            out = run_command_with_failover(env, "cluster.status")
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert out, "shell never recovered after mid-session leader death"
+    assert env.master != leader
