@@ -561,7 +561,8 @@ def main() -> None:
     mesh_gbps = None
     for chunk_mb, tile_kb in ((32, 16), (16, 16)):
         try:
-            r = _run_probe(["--probe-mesh", str(chunk_mb), str(tile_kb)])
+            r = _run_probe(["--probe-mesh", str(chunk_mb), str(tile_kb)],
+                           timeout=300)
             if r.returncode == 0 and r.stdout.strip():
                 mesh_gbps = float(r.stdout.strip().splitlines()[-1])
                 log(
@@ -584,6 +585,9 @@ def main() -> None:
     # 8 GB/s bar is cleared; smaller sizes are the low-HBM fallback
     rebuild = None
     for shard_mb in (256, 256, 128, 96, 64, 32, 16):
+        if rebuild is not None and time.perf_counter() - t_setup > 900:
+            log("rebuild sweep stopped on time budget")
+            break
         try:
             r = _run_probe(["--probe-rebuild", str(shard_mb), "32"])
             if r.returncode == 0 and r.stdout.strip():
@@ -638,6 +642,9 @@ def main() -> None:
     e2e = {}
     overlap_eff = None
     for sink in ("disk", "tmpfs", "null"):
+        if sink != "disk" and time.perf_counter() - t_setup > 1400:
+            log(f"e2e [{sink}] skipped on time budget")
+            continue
         try:
             r = _run_probe(["--probe-e2e", "128", sink])
             if r.returncode == 0 and r.stdout.strip():
@@ -667,7 +674,8 @@ def main() -> None:
     # -- remaining BASELINE.md configs (cpu 1GB, alt geometries, 1-missing) ---
     extras = None
     try:
-        r = _run_probe(["--probe-extras"], timeout=420)
+        budget_left = time.perf_counter() - t_setup < 1700
+        r = _run_probe(["--probe-extras"], timeout=420 if budget_left else 180)
         if r.returncode == 0 and r.stdout.strip():
             extras = json.loads(r.stdout.strip().splitlines()[-1])
             log(f"extras: {extras}")

@@ -44,18 +44,20 @@ class CommandEnv:
             )
 
     def re_resolve_master(self) -> bool:
-        """Mid-session failover: pick a (different) reachable seed after a
-        connection failure. True when the pinned master changed."""
+        """Mid-session failover: pick a (different) VERIFIED-reachable seed
+        after a connection failure. True only when the pinned master changed
+        to a seed that answered the probe — if nothing answers, the pin is
+        left alone (never trade a known address for an unverified one)."""
         if len(getattr(self, "master_seeds", [])) <= 1:
             return False
         from ..wdclient import find_reachable_master
 
         others = [m for m in self.master_seeds if m != self.master]
-        new = find_reachable_master(others + [self.master])
-        changed = bool(new) and new != self.master
-        if new:
-            self.master = new
-        return changed
+        new = find_reachable_master(others + [self.master], strict=True)
+        if not new or new == self.master:
+            return False
+        self.master = new
+        return True
 
     def lock(self) -> str:
         r = http_json("POST", f"http://{self.master}/cluster/lock?client=shell")

@@ -53,18 +53,37 @@ def _flags(parts: list[str]) -> dict[str, str]:
     return out
 
 
+# read-only commands the failover wrapper may silently re-run: they mutate
+# nothing, so replaying them after a mid-flight failure is always safe. A
+# mutating command (ec.encode's multi-step spread, volume.delete, ...) may
+# have PARTIALLY executed before the connection error — auto-retrying would
+# re-issue completed steps, so those surface the error with the new master.
+_RETRY_SAFE = {
+    "help", "cluster.status", "volume.list", "collection.list",
+    "bucket.list", "fs.ls", "fs.du", "fs.tree", "fs.cat", "fs.pwd",
+    "fs.meta.cat",
+}
+
+
 def run_command_with_failover(env: CommandEnv, line: str) -> object:
-    """run_command, retried ONCE against a re-resolved master when the
-    pinned one refuses connections mid-session (a refused connection means
-    nothing executed, so the retry is safe for every command)."""
+    """run_command with mid-session master failover: on a connection-level
+    failure the master is re-resolved to a verified-reachable seed;
+    read-only commands are then retried automatically, mutating ones
+    re-raise with the failover noted (the operator re-runs knowingly)."""
     import urllib.error
 
     try:
         return run_command(env, line)
-    except (OSError, urllib.error.URLError):
-        if env.re_resolve_master():
+    except (OSError, urllib.error.URLError) as e:
+        cmd = (line.strip().split() or [""])[0]
+        if not env.re_resolve_master():
+            raise
+        if cmd in _RETRY_SAFE:
             return run_command(env, line)
-        raise
+        raise RuntimeError(
+            f"{e} — master failed over to {env.master}; the command may "
+            f"have partially executed, re-run it deliberately"
+        ) from e
 
 
 def run_command(env: CommandEnv, line: str) -> object:

@@ -87,17 +87,21 @@ class VidMap:
             return len(self._locations)
 
 
-def find_reachable_master(seeds: list[str], timeout: float = 2.0) -> str:
+def find_reachable_master(seeds: list[str], timeout: float = 2.0,
+                          strict: bool = False) -> str:
     """First seed answering /cluster/status. Reachable beats leader-
     guessing: followers PROXY leader-only ops (master_server._leader_only),
     while a reported leader may itself be dead — never pin to an address
-    nobody verified. Falls back to the first seed when none answer."""
+    nobody verified. When none answer: '' under strict (callers that must
+    not act on an unverified address), else the first seed."""
     for m in seeds:
         try:
             http_json("GET", f"http://{m}/cluster/status", timeout=timeout)
             return m
         except Exception:
             continue
+    if strict:
+        return ""
     return seeds[0] if seeds else ""
 
 

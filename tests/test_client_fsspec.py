@@ -189,3 +189,44 @@ def test_append_mode_preserves_existing_content(fs):
     with fs.open("/app/new.txt", "ab") as f:
         f.write(b"first\n")
     assert fs.cat_file("/app/new.txt") == b"first\n"
+
+
+def test_concurrent_readers_and_writers(fs, cluster):
+    """Dask-style usage: many threads doing ranged reads of one big file
+    while others write distinct files — no cross-talk, no corruption."""
+    import threading
+
+    _, _, filer = cluster
+    payload = secrets.token_bytes(1_000_000)
+    fs.pipe_file("/conc/shared.bin", payload)
+    errors: list = []
+    barrier = threading.Barrier(12)
+
+    def reader(i):
+        try:
+            barrier.wait()
+            for j in range(8):
+                start = (i * 37 + j * 101) % (len(payload) - 5000)
+                got = fs.cat_file("/conc/shared.bin", start=start,
+                                  end=start + 5000)
+                assert got == payload[start:start + 5000], (i, j)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def writer(i):
+        try:
+            barrier.wait()
+            body = f"writer-{i}-".encode() * 1000
+            fs.pipe_file(f"/conc/w{i}.bin", body)
+            assert fs.cat_file(f"/conc/w{i}.bin") == body
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(8)]
+    threads += [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    assert fs.cat_file("/conc/shared.bin") == payload
