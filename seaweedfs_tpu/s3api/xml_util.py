@@ -3,6 +3,8 @@
 from __future__ import annotations
 
 import xml.etree.ElementTree as ET
+
+from ..util.safe_xml import safe_fromstring
 from typing import Any
 
 S3_XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
@@ -47,7 +49,7 @@ def strip_ns(tag: str) -> str:
 
 
 def parse_xml(body: bytes) -> ET.Element:
-    return ET.fromstring(body)
+    return safe_fromstring(body)
 
 
 def findall(el: ET.Element, tag: str) -> list[ET.Element]:

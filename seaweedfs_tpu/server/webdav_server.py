@@ -24,6 +24,7 @@ from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler
 
 from ..filer.client import FilerClient
+from ..util.safe_xml import safe_fromstring
 from .http_util import start_server
 
 DAV_NS = "DAV:"
@@ -243,7 +244,7 @@ class WebDavServer:
                 "Content-Type": 'text/xml; charset="utf-8"',
             }
         try:
-            info = ET.fromstring(body)
+            info = safe_fromstring(body)
         except ET.ParseError:
             return 400, b"", {}
         if info.find("{DAV:}lockscope/{DAV:}exclusive") is None:
@@ -310,7 +311,7 @@ class WebDavServer:
         if self._locked_without_token(fp, headers):
             return 423, b"", {}
         try:
-            update = ET.fromstring(body) if body.strip() else None
+            update = safe_fromstring(body) if body.strip() else None
         except ET.ParseError:
             return 400, b"", {}
         extended = dict(entry.get("extended") or {})

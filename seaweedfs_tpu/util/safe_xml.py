@@ -1,0 +1,38 @@
+"""DTD-free XML parsing for untrusted request bodies.
+
+Python's xml.etree EXPANDS internal entities, so a 'billion laughs' body
+(nested `<!ENTITY>` definitions) posted to any XML endpoint — WebDAV
+LOCK/PROPPATCH, S3 CompleteMultipartUpload / multi-object Delete — costs
+exponential memory before the handler sees a single element. The gateways
+never need DTDs (neither RFC 4918 clients nor AWS SDKs emit them), so the
+fix is defusedxml's stance: refuse the document the moment a DTD begins.
+
+Detection runs as a dedicated expat scan pass whose
+StartDoctypeDeclHandler raises — the scan aborts BEFORE any entity
+declaration is processed, so nothing ever expands. Hooking the PARSER
+(not grepping bytes) survives any encoding (a UTF-16 bomb has no literal
+b"<!DOCTYPE" in its bytes) and cannot false-positive on comments or
+CDATA that merely mention a DOCTYPE.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+import xml.parsers.expat as _expat
+
+
+def _forbid_dtd(*_a, **_k):
+    raise ET.ParseError("DTD/entity declarations are not accepted")
+
+
+def safe_fromstring(body: bytes | str) -> ET.Element:
+    raw = body.encode() if isinstance(body, str) else body
+    scan = _expat.ParserCreate()
+    scan.StartDoctypeDeclHandler = _forbid_dtd
+    try:
+        scan.Parse(raw, True)
+    except ET.ParseError:
+        raise  # the forbid handler fired: a DTD was declared
+    except _expat.ExpatError:
+        pass  # malformed for other reasons: ET below raises its ParseError
+    return ET.fromstring(raw)

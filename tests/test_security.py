@@ -165,3 +165,80 @@ def test_guard_blocks_ip(tmp_path):
     finally:
         vol.stop()
         master.stop()
+
+
+def test_xml_entity_bombs_rejected():
+    """ElementTree expands internal entities; a billion-laughs body must be
+    refused up front by every XML-accepting gateway surface."""
+    import xml.etree.ElementTree as ET
+
+    import pytest as _pytest
+
+    from seaweedfs_tpu.s3api.xml_util import parse_xml
+    from seaweedfs_tpu.util.safe_xml import safe_fromstring
+
+    bomb = (
+        b'<?xml version="1.0"?><!DOCTYPE lolz [<!ENTITY a "ha">'
+        + b"".join(
+            f'<!ENTITY {chr(98 + i)} "&{chr(97 + i)};&{chr(97 + i)};">'.encode()
+            for i in range(8)
+        )
+        + b"]><r>&i;</r>"
+    )
+    for fn in (safe_fromstring, parse_xml):
+        with _pytest.raises(ET.ParseError):
+            fn(bomb)
+        with _pytest.raises(ET.ParseError):
+            fn(b'<!DOCTYPE x SYSTEM "file:///etc/passwd"><r/>')
+        # encoding must not matter: a UTF-16 bomb has no literal
+        # b"<!DOCTYPE" to grep for — detection is at the parser
+        with _pytest.raises(ET.ParseError):
+            fn(bomb.decode().encode("utf-16"))
+    # comments/CDATA mentioning a DOCTYPE are NOT a DTD
+    ok = safe_fromstring(b'<r><!-- <!DOCTYPE --><![CDATA[<!ENTITY]]></r>')
+    assert ok.tag == "r"
+    # plain documents still parse, namespaces intact
+    el = safe_fromstring(b'<D:prop xmlns:D="DAV:"><D:x>1</D:x></D:prop>')
+    assert el.tag == "{DAV:}prop"
+
+
+def test_webdav_lock_rejects_doctype(tmp_path):
+    """End-to-end: a LOCK body carrying a DTD gets 400, not expansion."""
+    import socket as _socket
+    import urllib.request
+
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.webdav_server import WebDavServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    def fp():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    ms = MasterServer(port=fp(), node_timeout=60).start()
+    vs = VolumeServer([str(tmp_path)], port=fp(), master_url=ms.url,
+                      pulse_seconds=0.5).start()
+    fs = FilerServer(port=fp(), master_url=ms.url).start()
+    dav = WebDavServer(port=fp(), filer_url=fs.url).start()
+    try:
+        evil = (b'<?xml version="1.0"?><!DOCTYPE l [<!ENTITY a "x">]>'
+                b'<D:lockinfo xmlns:D="DAV:"><D:lockscope><D:exclusive/>'
+                b"</D:lockscope><D:locktype><D:write/></D:locktype>"
+                b"</D:lockinfo>")
+        req = urllib.request.Request(
+            f"http://{dav.url}/f.txt", data=evil, method="LOCK"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("DTD LOCK body must be rejected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400, e.code
+    finally:
+        dav.stop()
+        fs.stop()
+        vs.stop()
+        ms.stop()
