@@ -339,9 +339,129 @@ class FilerServer:
         return [int(x) for x in raw.split(",") if x] or None
 
     # -- write path (auto-chunking) ------------------------------------------
-    def _h_write(self, h, path, q, body):
+    @JsonHandler.mark_streaming
+    def _h_write_stream(self, h, path, q, rfile, length):
+        """Streaming front of the write path (filer_server_handlers_write_
+        autochunk.go uploadReaderToChunks): file bodies are consumed from
+        the socket one chunk at a time — peak memory is one chunk + its
+        ciphertext regardless of file size. Metadata-shaped requests
+        (rename/link/meta/mkdir) buffer their small bodies and take the
+        plain path."""
+        parsed_path = urllib.parse.unquote(path)
+        meta_shaped = (
+            q.get("mv.to") or q.get("link.to") or q.get("meta") == "true"
+            or parsed_path.endswith("/")
+        )
         with self._req_hist.time(op="write"):
-            return self._h_write_inner(h, path, q, body)
+            if meta_shaped:
+                body = rfile.read(length) if length else b""
+                return self._h_write_inner(h, path, q, body)
+            return self._h_write_file(h, parsed_path, q, rfile, length)
+
+    def _read_exact(self, rfile, want: int) -> bytes:
+        out = bytearray()
+        while len(out) < want:
+            got = rfile.read(want - len(out))
+            if not got:
+                raise IOError(
+                    f"client disconnected {want - len(out)} bytes early"
+                )
+            out += got
+        return bytes(out)
+
+    def _h_write_file(self, h, path, q, rfile, length):
+        # path-prefix storage rules (filer_conf.go): explicit query params
+        # win, then the longest-prefix rule, then server defaults
+        rule = self.filer_conf.match_storage_rule(path)
+        collection = q.get("collection") or rule.collection or self.collection
+        replication = q.get("replication") or rule.replication or self.replication
+        ttl = q.get("ttl") or rule.ttl or ""
+        use_cipher = self.cipher or q.get("cipher") == "true"
+        chunks: list[FileChunk] = []
+        uploaded_fids: list[str] = []  # every fid stored, incl. manifest blobs
+        md5 = hashlib.md5()
+        offset = 0
+        try:
+            while offset < length:
+                piece = self._read_exact(
+                    rfile, min(self.chunk_size, length - offset)
+                )
+                md5.update(piece)
+                chunk = self._upload_piece(
+                    piece, offset, collection, replication, ttl, use_cipher
+                )
+                uploaded_fids.append(chunk.file_id)
+                chunks.append(chunk)
+                offset += len(piece)
+            if len(chunks) >= self.manifest_batch:
+                from ..filer.filechunk_manifest import maybe_manifestize
+
+                def _save(blob):
+                    c = self._save_blob_as_chunk(
+                        blob, collection, replication, ttl, use_cipher
+                    )
+                    uploaded_fids.append(c.file_id)
+                    return c
+
+                chunks = maybe_manifestize(_save, chunks, self.manifest_batch)
+            # header names arrive case-mangled (urllib capitalizes);
+            # Title-Case them so readers filter with a canonical prefix
+            extended = {
+                k[len("Seaweed-") :].title(): v
+                for k, v in h.headers.items()
+                if k.title().startswith("Seaweed-")
+            }
+            extended["md5"] = md5.hexdigest()
+            entry = Entry(
+                full_path=path,
+                mime=h.headers.get("Content-Type", "") or "",
+                collection=collection,
+                replication=replication,
+                chunks=chunks,
+                extended=extended,
+            )
+            self.filer.create_entry(entry, signatures=self._sigs(q))
+        except Exception:
+            # nothing was committed (create_entry is the commit point):
+            # don't leak ANY stored chunk — data or manifest blob
+            if uploaded_fids:
+                self._purge_chunks(uploaded_fids)
+            raise
+        self._maybe_reload_conf(path)
+        return 201, {
+            "name": entry.name,
+            "size": length,
+            "chunks": len(chunks),
+            "eTag": extended["md5"],
+        }
+
+    def _upload_piece(self, piece: bytes, offset: int, collection: str,
+                      replication: str, ttl: str, use_cipher: bool) -> FileChunk:
+        a = operation.assign(
+            self.master_url,
+            collection=collection,
+            replication=replication,
+            ttl=ttl,
+        )
+        cipher_key_b64 = ""
+        payload = piece
+        if use_cipher:
+            # fresh key per chunk; the store holds only ciphertext and the
+            # filer entry holds the key (_write_cipher.go)
+            from ..util import cipher as cipher_mod
+
+            key = cipher_mod.gen_cipher_key()
+            payload = cipher_mod.encrypt(piece, key)
+            cipher_key_b64 = base64.b64encode(key).decode()
+        r = operation.upload_data(a.url, a.fid, payload, ttl=ttl, jwt=a.auth)
+        return FileChunk(
+            file_id=a.fid,
+            offset=offset,
+            size=len(piece),  # logical (plaintext) size
+            mtime=time.time_ns(),
+            etag=r.get("eTag", ""),
+            cipher_key=cipher_key_b64,
+        )
 
     def _h_write_inner(self, h, path, q, body):
         path = urllib.parse.unquote(path)
@@ -369,82 +489,9 @@ class FilerServer:
                 self.filer.create_entry(entry)
                 return 201, {"name": entry.name}
             return 400, {"error": "cannot write to a directory path"}
-        # path-prefix storage rules (filer_conf.go): explicit query params
-        # win, then the longest-prefix rule, then server defaults
-        rule = self.filer_conf.match_storage_rule(path)
-        collection = q.get("collection") or rule.collection or self.collection
-        replication = q.get("replication") or rule.replication or self.replication
-        ttl = q.get("ttl") or rule.ttl or ""
-        use_cipher = self.cipher or q.get("cipher") == "true"
-        chunks = []
-        offset = 0
-        mv = memoryview(body)
-        while offset < len(body):  # empty bodies store an entry with no chunks
-            piece = bytes(mv[offset : offset + self.chunk_size])
-            a = operation.assign(
-                self.master_url,
-                collection=collection,
-                replication=replication,
-                ttl=ttl,
-            )
-            cipher_key_b64 = ""
-            payload = piece
-            if use_cipher:
-                # fresh key per chunk; the store holds only ciphertext and
-                # the filer entry holds the key (_write_cipher.go)
-                from ..util import cipher as cipher_mod
-
-                key = cipher_mod.gen_cipher_key()
-                payload = cipher_mod.encrypt(piece, key)
-                cipher_key_b64 = base64.b64encode(key).decode()
-            r = operation.upload_data(a.url, a.fid, payload, ttl=ttl, jwt=a.auth)
-            chunks.append(
-                FileChunk(
-                    file_id=a.fid,
-                    offset=offset,
-                    size=len(piece),  # logical (plaintext) size
-                    mtime=time.time_ns(),
-                    etag=r.get("eTag", ""),
-                    cipher_key=cipher_key_b64,
-                )
-            )
-            offset += len(piece)
-        if len(chunks) >= self.manifest_batch:
-            # chunk-of-chunks packing keeps entry metadata bounded for
-            # TB-scale files (filechunk_manifest.go MaybeManifestize)
-            from ..filer.filechunk_manifest import maybe_manifestize
-
-            chunks = maybe_manifestize(
-                lambda blob: self._save_blob_as_chunk(
-                    blob, collection, replication, ttl, use_cipher
-                ),
-                chunks,
-                self.manifest_batch,
-            )
-        # header names arrive case-mangled (urllib capitalizes); Title-Case
-        # them so readers can filter with a canonical prefix
-        extended = {
-            k[len("Seaweed-") :].title(): v
-            for k, v in h.headers.items()
-            if k.title().startswith("Seaweed-")
-        }
-        extended["md5"] = hashlib.md5(body).hexdigest()
-        entry = Entry(
-            full_path=path,
-            mime=h.headers.get("Content-Type", "") or "",
-            collection=collection,
-            replication=replication,
-            chunks=chunks,
-            extended=extended,
-        )
-        self.filer.create_entry(entry, signatures=self._sigs(q))
-        self._maybe_reload_conf(path)
-        return 201, {
-            "name": entry.name,
-            "size": len(body),
-            "chunks": len(chunks),
-            "eTag": extended["md5"],
-        }
+        # every meta_shaped condition returned above; file bodies go through
+        # _h_write_file via the streaming dispatch, never through here
+        raise AssertionError("non-meta body reached _h_write_inner")
 
     # -- read path ------------------------------------------------------------
     def _h_read(self, h, path, q, body):
@@ -668,8 +715,8 @@ class FilerServer:
                 ("DELETE", "/_kv/", fs._h_kv),
                 ("GET", "/", fs._h_read),
                 ("HEAD", "/", fs._h_head),
-                ("POST", "/", fs._h_write),
-                ("PUT", "/", fs._h_write),
+                ("POST", "/", fs._h_write_stream),
+                ("PUT", "/", fs._h_write_stream),
                 ("DELETE", "/", fs._h_delete),
             ]
 

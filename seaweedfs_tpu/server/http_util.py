@@ -30,21 +30,51 @@ class JsonHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # stdlib chatter → V(3)
         glog.V(3).info("http: " + fmt, *args)
 
+    @staticmethod
+    def mark_streaming(fn):
+        """Tag a route handler as streaming: it is called as
+        fn(h, path, query, rfile, length) BEFORE the body is buffered and
+        must consume exactly `length` bytes from rfile (uploads then hold
+        one chunk in memory at a time instead of the whole body)."""
+        fn._streaming = True
+        return fn
+
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
         query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
         length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length) if length else b""
+        body = None  # read lazily: streaming handlers consume rfile directly
         for m, prefix, fn in self.routes:
             if m == method and parsed.path.startswith(prefix):
+                streaming = getattr(fn, "_streaming", False)
                 try:
-                    status, payload = fn(self, parsed.path, query, body)
+                    if streaming:
+                        status, payload = fn(
+                            self, parsed.path, query, self.rfile, length
+                        )
+                    else:
+                        if body is None:
+                            body = self.rfile.read(length) if length else b""
+                        status, payload = fn(self, parsed.path, query, body)
                 except Exception as e:
                     glog.exception("%s %s failed", method, parsed.path)
                     status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+                    if streaming:
+                        # the request body may be half-consumed; keep-alive
+                        # framing is gone, so drop the connection after reply
+                        self.close_connection = True
                 glog.V(2).info("%s %s → %d", method, parsed.path, status)
                 self._reply(status, payload, head_only=(method == "HEAD"))
                 return
+        if body is None and length:
+            # drain in bounded pieces for keep-alive correctness — a multi-GB
+            # body to an unrouted path must not be buffered whole
+            left = length
+            while left > 0:
+                got = self.rfile.read(min(1 << 20, left))
+                if not got:
+                    break
+                left -= len(got)
         self._reply(404, {"error": f"no route {method} {parsed.path}"})
 
     def _reply(self, status: int, payload, head_only: bool = False) -> None:
@@ -69,7 +99,12 @@ class JsonHandler(BaseHTTPRequestHandler):
         self.extra_headers = None
         self.end_headers()
         if not head_only:  # HEAD: headers only, or keep-alive framing breaks
-            self.wfile.write(data)
+            try:
+                self.wfile.write(data)
+            except (BrokenPipeError, ConnectionResetError):
+                # peer vanished mid-reply (e.g. aborted its own upload);
+                # nothing to salvage — just stop reusing the socket
+                self.close_connection = True
 
     def do_GET(self):
         self._dispatch("GET")
