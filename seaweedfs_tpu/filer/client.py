@@ -44,6 +44,42 @@ class FilerClient:
         with urllib.request.urlopen(req, timeout=60) as resp:
             return json.loads(resp.read())
 
+    def put_object_stream(
+        self,
+        path: str,
+        rfile,
+        length: int,
+        content_type: str = "",
+    ) -> dict:
+        """PUT with the body streamed from a file-like source: urllib feeds
+        http.client's blocksize loop, and the filer's streaming write path
+        chunks it on arrival — an upload of any size flows end-to-end in
+        bounded memory. The source is clamped to `length` bytes and a short
+        read raises instead of silently truncating."""
+
+        class _Exact:
+            def __init__(self, src, left):
+                self._src, self._left = src, left
+
+            def read(self, n=-1):
+                if self._left <= 0:
+                    return b""
+                want = self._left if n is None or n < 0 else min(n, self._left)
+                got = self._src.read(want)
+                if not got:
+                    raise IOError(f"source ended {self._left} bytes early")
+                self._left -= len(got)
+                return got
+
+        req = urllib.request.Request(
+            self._u(path), data=_Exact(rfile, length), method="PUT"
+        )
+        req.add_header("Content-Length", str(length))
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return json.loads(resp.read())
+
     def get_object(
         self, path: str, rng: Optional[str] = None
     ) -> tuple[int, bytes, dict]:

@@ -276,21 +276,30 @@ class _Session(threading.Thread):
         if data is None:
             return
         self.send(150, "Ok to send data.")
-        chunks = []
+        # FTP sends until data-socket EOF (no length up front), so spool to
+        # a size-capped temp file — big uploads ride the disk, then stream
+        # to the filer with a known length (bounded gateway memory)
+        import tempfile
+
+        spool = tempfile.SpooledTemporaryFile(max_size=8 * 1024 * 1024)
         try:
-            while True:
-                buf = data.recv(65536)
-                if not buf:
-                    break
-                chunks.append(buf)
+            if append:
+                status, old, _ = self.srv.client.get_object(path)
+                if status == 200:
+                    spool.write(old)
+            try:
+                while True:
+                    buf = data.recv(65536)
+                    if not buf:
+                        break
+                    spool.write(buf)
+            finally:
+                data.close()
+            size = spool.tell()
+            spool.seek(0)
+            self.srv.client.put_object_stream(path, spool, size)
         finally:
-            data.close()
-        body = b"".join(chunks)
-        if append:
-            status, old, _ = self.srv.client.get_object(path)
-            if status == 200:
-                body = old + body
-        self.srv.client.put_object(path, body)
+            spool.close()
         self.send(226, "Transfer complete.")
 
     def do_STOR(self, arg):

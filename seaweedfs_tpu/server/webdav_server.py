@@ -58,6 +58,23 @@ class DavLock:
         )
 
 
+class _CountedReader:
+    """Bounded view of a request body stream; tracks unconsumed bytes so
+    the handler knows when keep-alive framing was abandoned."""
+
+    def __init__(self, rfile, length: int):
+        self._rfile = rfile
+        self.left = length
+
+    def read(self, n: int = -1) -> bytes:
+        if self.left <= 0:
+            return b""
+        want = self.left if n is None or n < 0 else min(n, self.left)
+        got = self._rfile.read(want)
+        self.left -= len(got)
+        return got
+
+
 def _rfc1123(ts: float) -> str:
     return datetime.fromtimestamp(ts, tz=timezone.utc).strftime(
         "%a, %d %b %Y %H:%M:%S GMT"
@@ -412,8 +429,12 @@ class WebDavServer:
         existing = self.client.get_entry(fp)
         if existing is not None and existing.get("is_directory"):
             return 405, b"", {}
-        self.client.put_object(
-            fp, body, content_type=headers.get("Content-Type", "")
+        # the handler always packs PUT bodies as (reader, length): stream
+        # gateway→filer so a multi-GB PUT never materializes here either
+        reader, length = body
+        self.client.put_object_stream(
+            fp, reader, length,
+            content_type=headers.get("Content-Type", ""),
         )
         return 201 if existing is None else 204, b"", {}
 
@@ -520,7 +541,13 @@ class WebDavServer:
             def _go(self, method):
                 parsed = urllib.parse.urlparse(self.path)
                 length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
+                reader = None
+                if method == "PUT":
+                    # stream PUT bodies straight through to the filer
+                    reader = _CountedReader(self.rfile, length)
+                    body = (reader, length)
+                else:
+                    body = self.rfile.read(length) if length else b""
                 headers = {k.title(): v for k, v in self.headers.items()}
                 if method == "HEAD":
                     fn = lambda p, h, b: dav.do_get(p, h, b, head=True)  # noqa: E731
@@ -533,6 +560,10 @@ class WebDavServer:
                         status, payload, extra = fn(parsed.path, headers, body)
                     except Exception as e:  # noqa: BLE001
                         status, payload, extra = 500, str(e).encode(), {}
+                if reader is not None and reader.left > 0:
+                    # PUT refused before the body was consumed (423/405/...):
+                    # keep-alive framing is gone, drop the connection
+                    self.close_connection = True
                 self.send_response(status)
                 clen = extra.pop("Content-Length-Override", None)
                 if "Content-Type" not in extra and payload:

@@ -332,3 +332,40 @@ def test_concurrent_exclusive_locks_one_winner(webdav):
     assert len(losers) == 7, results
     dav("UNLOCK", f"{base}/contended.txt", b"",
         {"Lock-Token": f"<{winners[0][1].strip('<>')}>"})
+
+
+def test_streamed_large_put_roundtrip(webdav):
+    """A large PUT flows gateway→filer as a stream (no whole-body buffer);
+    bytes survive and ranged GET works."""
+    import http.client
+    import os as _os
+
+    host, port = webdav.url.split(":")
+    total = 40 * 1024 * 1024
+    block = _os.urandom(1024 * 1024)
+    conn = http.client.HTTPConnection(host, int(port), timeout=120)
+    conn.putrequest("PUT", "/big/stream.bin")
+    conn.putheader("Content-Length", str(total))
+    conn.endheaders()
+    for _ in range(40):
+        conn.send(block)
+    resp = conn.getresponse()
+    assert resp.status in (201, 204), resp.read()[:200]
+    resp.read()
+    conn.close()
+    status, body, _ = dav("GET", f"http://{webdav.url}/big/stream.bin",
+                          headers={"Range": "bytes=1048000-1049000"})
+    whole = block * 40
+    assert status == 206 and body == whole[1048000:1049001]
+    # a locked target refuses the PUT without consuming the body
+    st, _, h = dav("LOCK", f"http://{webdav.url}/big/stream.bin", LOCKINFO)
+    token = _token(h)
+    conn = http.client.HTTPConnection(host, int(port), timeout=60)
+    conn.putrequest("PUT", "/big/stream.bin")
+    conn.putheader("Content-Length", str(total))
+    conn.endheaders()  # send NO body: a 423 must come back anyway
+    resp = conn.getresponse()
+    assert resp.status == 423
+    conn.close()
+    dav("UNLOCK", f"http://{webdav.url}/big/stream.bin", b"",
+        {"Lock-Token": f"<{token}>"})
