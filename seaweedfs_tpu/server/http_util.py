@@ -12,6 +12,16 @@ from typing import Any, Callable, Optional
 from seaweedfs_tpu.util import glog
 
 
+class StreamBody:
+    """Handler return value for incrementally-produced response bodies:
+    `length` goes in Content-Length, `chunks` (an iterable of bytes) is
+    written piece by piece."""
+
+    def __init__(self, length: int, chunks):
+        self.length = length
+        self.chunks = chunks
+
+
 class JsonHandler(BaseHTTPRequestHandler):
     """Route table based handler; subclasses set `routes` as
     [(method, path_prefix, fn)] where fn(handler, path, query, body) →
@@ -78,6 +88,9 @@ class JsonHandler(BaseHTTPRequestHandler):
         self._reply(404, {"error": f"no route {method} {parsed.path}"})
 
     def _reply(self, status: int, payload, head_only: bool = False) -> None:
+        if isinstance(payload, StreamBody):
+            self._reply_stream(status, payload, head_only)
+            return
         if isinstance(payload, (bytes, bytearray)):
             data = bytes(payload)
             ctype = "application/octet-stream"
@@ -105,6 +118,42 @@ class JsonHandler(BaseHTTPRequestHandler):
                 # peer vanished mid-reply (e.g. aborted its own upload);
                 # nothing to salvage — just stop reusing the socket
                 self.close_connection = True
+
+    def _reply_stream(self, status: int, body: "StreamBody",
+                      head_only: bool) -> None:
+        """Send a response whose bytes arrive incrementally (filer
+        StreamContent analog): Content-Length up front, pieces written as
+        they are produced — the daemon never holds the whole object."""
+        self.send_response(status)
+        ctype = "application/octet-stream"
+        if self.extra_headers and "Content-Type" in self.extra_headers:
+            ctype = self.extra_headers.pop("Content-Type")
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(body.length))
+        for k, v in (self.extra_headers or {}).items():
+            self.send_header(k, v)
+        self.extra_headers = None
+        self.end_headers()
+        if head_only:
+            return
+        sent = 0
+        try:
+            for piece in body.chunks:
+                self.wfile.write(piece)
+                sent += len(piece)
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+            return
+        except Exception:
+            # headers are gone; the only honest signal is a short body
+            glog.exception("stream reply failed after %d/%d bytes",
+                           sent, body.length)
+            self.close_connection = True
+            return
+        if sent != body.length:
+            glog.error("stream reply produced %d of %d bytes", sent,
+                       body.length)
+            self.close_connection = True
 
     def do_GET(self):
         self._dispatch("GET")

@@ -335,5 +335,27 @@ def test_streaming_upload_bounds_filer_memory(tmp_path):
         )
         with urllib.request.urlopen(req, timeout=60) as r:
             assert r.read() == block[:1048576]
+        # reads stream too: a full-body GET drained in pieces must not
+        # re-inflate the filer to body size
+        peak[0] = rss_mb()
+        stop.clear()
+        t2 = threading.Thread(target=sample, daemon=True)
+        t2.start()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{fp_}/big/stream.bin", timeout=300
+        ) as r:
+            got = 0
+            first = r.read(len(block))
+            assert first == block
+            got += len(first)
+            while True:
+                piece = r.read(8 * 1024 * 1024)
+                if not piece:
+                    break
+                got += len(piece)
+        assert got == total
+        stop.set()
+        t2.join(timeout=2)
+        assert peak[0] < 280, f"filer RSS peaked at {peak[0]:.0f} MB on GET"
     finally:
         _terminate(filer, volume, master)
