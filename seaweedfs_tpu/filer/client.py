@@ -80,6 +80,25 @@ class FilerClient:
         with urllib.request.urlopen(req, timeout=600) as resp:
             return json.loads(resp.read())
 
+    def get_object_stream(
+        self, path: str, rng: Optional[str] = None
+    ) -> tuple[int, object, dict]:
+        """GET whose body stays ON THE WIRE: returns (status, file-like
+        response, headers) so gateways can pass bytes through piecewise
+        instead of buffering whole objects (pairs with the filer's
+        streaming read path). The caller must .close() the response; error
+        statuses return the (small) error body as bytes instead."""
+        req = urllib.request.Request(self._u(path), method="GET")
+        if rng:
+            req.add_header("Range", rng)
+        try:
+            resp = urllib.request.urlopen(req, timeout=600)
+            return resp.status, resp, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            e.close()
+            return e.code, body, dict(e.headers)
+
     def get_object(
         self, path: str, rng: Optional[str] = None
     ) -> tuple[int, bytes, dict]:

@@ -21,7 +21,7 @@ import uuid
 from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler
 
-from ..server.http_util import start_server
+from ..server.http_util import relay_stream, start_server
 from . import auth as s3auth
 from . import policy_engine as pe
 from . import post_policy as pp
@@ -300,11 +300,22 @@ class S3ApiServer:
             resp_headers["Content-Length-Override"] = str(size)
             return 200, b"", resp_headers
         rng = headers.get("Range", "")
-        status, data, h = self.client.get_object(path, rng=rng or None)
+        status, data, h = self.client.get_object_stream(path, rng=rng or None)
         if status not in (200, 206):
+            if hasattr(data, "close"):
+                data.close()
             return _err("NoSuchKey", key)
         if status == 206 and "Content-Range" in h:
             resp_headers["Content-Range"] = h["Content-Range"]
+        clen = h.get("Content-Length")
+        if clen is None:
+            # without an upstream length a relayed body would corrupt
+            # keep-alive framing; the filer always sends one, so this is
+            # a broken upstream — fail loudly instead
+            data.close()
+            return _err("InternalError", key)
+        # file-like body: the handler streams it through in pieces
+        resp_headers["Content-Length-Override"] = clen
         return status, data, resp_headers
 
     def _delete_object(self, bucket, key):
@@ -898,17 +909,26 @@ class S3ApiServer:
                 else:
                     status, payload, extra = result
                 self.send_response(status)
+                streaming = hasattr(payload, "read")
                 clen = extra.pop("Content-Length-Override", None)
                 ctype = extra.pop(
                     "Content-Type",
                     "application/xml" if payload else "application/octet-stream",
                 )
                 self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", clen or str(len(payload)))
+                if streaming:
+                    self.send_header("Content-Length", clen)  # always set
+                else:
+                    self.send_header("Content-Length", clen or str(len(payload)))
                 for k, v in extra.items():
                     self.send_header(k, v)
                 self.end_headers()
-                if method != "HEAD" and payload:
+                if streaming:
+                    if method == "HEAD":
+                        payload.close()
+                    else:
+                        relay_stream(self, payload, int(clen))
+                elif method != "HEAD" and payload:
                     self.wfile.write(payload)
 
             def do_GET(self):

@@ -12,6 +12,37 @@ from typing import Any, Callable, Optional
 from seaweedfs_tpu.util import glog
 
 
+def relay_stream(handler, payload, declared_len: Optional[int] = None) -> None:
+    """Pipe a file-like body to handler.wfile in bounded pieces, with the
+    same error discipline as _reply_stream: peer-gone and upstream failures
+    both log, close the payload, and drop the connection (headers are
+    already sent — a short body + closed socket is the only honest
+    signal). Shared by the S3 and WebDAV gateway relays."""
+    sent = 0
+    try:
+        while True:
+            piece = payload.read(1 << 20)
+            if not piece:
+                break
+            handler.wfile.write(piece)
+            sent += len(piece)
+    except (BrokenPipeError, ConnectionResetError):
+        handler.close_connection = True
+        return
+    except Exception:
+        glog.exception("stream relay failed after %d bytes", sent)
+        handler.close_connection = True
+        return
+    finally:
+        try:
+            payload.close()
+        except Exception:
+            pass
+    if declared_len is not None and sent != declared_len:
+        glog.error("stream relay produced %d of %d bytes", sent, declared_len)
+        handler.close_connection = True
+
+
 class StreamBody:
     """Handler return value for incrementally-produced response bodies:
     `length` goes in Content-Length, `chunks` (an iterable of bytes) is

@@ -25,7 +25,7 @@ from http.server import BaseHTTPRequestHandler
 
 from ..filer.client import FilerClient
 from ..util.safe_xml import safe_fromstring
-from .http_util import start_server
+from .http_util import relay_stream, start_server
 
 DAV_NS = "DAV:"
 
@@ -417,9 +417,17 @@ class WebDavServer:
             )
             extra["Content-Length-Override"] = str(size)
             return 200, b"", extra
-        status, data, h = self.client.get_object(fp, rng=headers.get("Range"))
+        status, data, h = self.client.get_object_stream(
+            fp, rng=headers.get("Range")
+        )
         if status == 206 and "Content-Range" in h:
             extra["Content-Range"] = h["Content-Range"]
+        if hasattr(data, "read"):  # pass the stream through piecewise
+            clen = h.get("Content-Length")
+            if clen is None:  # broken upstream; never guess a length
+                data.close()
+                return 502, b"", {}
+            extra["Content-Length-Override"] = clen
         return status, data, extra
 
     def do_put(self, path, headers, body):
@@ -565,14 +573,23 @@ class WebDavServer:
                     # keep-alive framing is gone, drop the connection
                     self.close_connection = True
                 self.send_response(status)
+                streaming = hasattr(payload, "read")
                 clen = extra.pop("Content-Length-Override", None)
-                if "Content-Type" not in extra and payload:
+                if "Content-Type" not in extra and (payload or streaming):
                     extra["Content-Type"] = "application/octet-stream"
-                self.send_header("Content-Length", clen or str(len(payload)))
+                self.send_header(
+                    "Content-Length",
+                    clen if streaming else (clen or str(len(payload))),
+                )
                 for k, v in extra.items():
                     self.send_header(k, v)
                 self.end_headers()
-                if method != "HEAD" and payload:
+                if streaming:
+                    if method == "HEAD":
+                        payload.close()
+                    else:
+                        relay_stream(self, payload, int(clen))
+                elif method != "HEAD" and payload:
                     self.wfile.write(payload)
 
             def do_OPTIONS(self):
