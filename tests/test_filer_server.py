@@ -138,3 +138,42 @@ def test_kv_put_get_delete_http(cluster):
     fc.kv_delete("sync/offset-a")
     assert fc.kv_get("sync/offset-a") is None
     fc.kv_delete("sync/never-existed")  # no-op, not an error
+
+
+def test_http_surface_fuzz_burst(cluster):
+    """Hostile/garbled traffic against the live filer — truncated bodies,
+    bogus Content-Lengths, weird methods, binary paths — must never take
+    the daemon down or wedge subsequent well-formed requests."""
+    import random
+
+    from tests.test_turbo_fuzz import _poke
+
+    _, _, filer = cluster
+    rng = random.Random(7)
+    port = int(filer.url.split(":")[1])
+    hdr_bomb = b"".join(b"X-%d: y\r\n" % j for j in range(2000))
+    payloads = [
+        # truncated body: promise more than we send, then vanish
+        b"POST /fz/a HTTP/1.1\r\nHost: x\r\nContent-Length: 100000\r\n\r\nshort",
+        # negative / garbage CL
+        b"POST /fz/b HTTP/1.1\r\nHost: x\r\nContent-Length: -5\r\n\r\n",
+        # unknown method
+        b"BREW /fz/c HTTP/1.1\r\nHost: x\r\n\r\n",
+        None,  # binary garbage, regenerated per round
+        # header bomb (stdlib caps at 100 headers -> 431)
+        b"GET /fz HTTP/1.1\r\nHost: x\r\n" + hdr_bomb + b"\r\n",
+        # pipelined mix: valid GET then garbage
+        b"GET /fz/missing HTTP/1.1\r\nHost: x\r\n\r\n\x00\xff\x01",
+    ]
+    for i in range(120):
+        p = payloads[rng.randrange(len(payloads))]
+        if p is None:
+            p = bytes(rng.randrange(256) for _ in range(200))
+        _poke(port, p, read_timeout=0.3)
+    # the daemon is still healthy for well-formed traffic
+    from seaweedfs_tpu.server.http_util import http_bytes
+
+    st, _ = http_bytes("POST", f"http://{filer.url}/fz/ok.txt", b"alive")
+    assert st == 201
+    st, data = http_bytes("GET", f"http://{filer.url}/fz/ok.txt")
+    assert (st, data) == (200, b"alive")
