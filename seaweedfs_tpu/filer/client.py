@@ -50,6 +50,7 @@ class FilerClient:
         rfile,
         length: int,
         content_type: str = "",
+        extended: Optional[dict] = None,
     ) -> dict:
         """PUT with the body streamed from a file-like source: urllib feeds
         http.client's blocksize loop, and the filer's streaming write path
@@ -77,6 +78,8 @@ class FilerClient:
         req.add_header("Content-Length", str(length))
         if content_type:
             req.add_header("Content-Type", content_type)
+        for k, v in (extended or {}).items():
+            req.add_header(f"Seaweed-{k}", v)
         with urllib.request.urlopen(req, timeout=600) as resp:
             return json.loads(resp.read())
 

@@ -21,7 +21,7 @@ import uuid
 from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler
 
-from ..server.http_util import relay_stream, start_server
+from ..server.http_util import CountedReader, relay_stream, start_server
 from . import auth as s3auth
 from . import policy_engine as pe
 from . import post_policy as pp
@@ -222,9 +222,12 @@ class S3ApiServer:
 
     # ---------------------------------------------------------------- objects
     def _put_object(self, bucket, key, headers, body):
+        streamed = isinstance(body, tuple)  # (reader, length) pass-through
         if not self._bucket_exists(bucket):
             return _err("NoSuchBucket", bucket)
         if key.endswith("/"):
+            if streamed:
+                body[0].drain()  # directory markers carry no meaningful body
             self.client.mkdir(self._object_path(bucket, key[:-1]))
             return 200, b"", {"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'}
         src = headers.get("X-Amz-Copy-Source", "")
@@ -242,12 +245,20 @@ class S3ApiServer:
             for k, v in headers.items()
             if k.lower().startswith("x-amz-meta-")
         }
-        r = self.client.put_object(
-            self._object_path(bucket, key),
-            body,
-            content_type=headers.get("Content-Type", ""),
-            extended=extended,
-        )
+        if streamed:
+            reader, length = body
+            r = self.client.put_object_stream(
+                self._object_path(bucket, key), reader, length,
+                content_type=headers.get("Content-Type", ""),
+                extended=extended,
+            )
+        else:
+            r = self.client.put_object(
+                self._object_path(bucket, key),
+                body,
+                content_type=headers.get("Content-Type", ""),
+                extended=extended,
+            )
         return 200, b"", {"ETag": f'"{r.get("eTag", "")}"'}
 
     def _copy_object(self, bucket, key, src):
@@ -897,12 +908,32 @@ class S3ApiServer:
                     ).items()
                 }
                 length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
                 headers = {k.title(): v for k, v in self.headers.items()}
+                # stream-eligible object PUT: auth never needs the bytes
+                # (unsigned/absent payload hash) and no sub-resource is
+                # addressed, so the body can flow straight to the filer
+                sha = headers.get("X-Amz-Content-Sha256", "")
+                reader = None
+                if (
+                    method == "PUT"
+                    and length > 0
+                    and sha in ("", s3auth.UNSIGNED_PAYLOAD)
+                    and not query
+                    and "X-Amz-Copy-Source" not in headers
+                    and parsed.path.count("/") >= 2  # /bucket/key, not /bucket
+                ):
+                    reader = CountedReader(self.rfile, length)
+                    body = (reader, length)
+                else:
+                    body = self.rfile.read(length) if length else b""
                 try:
                     result = api.handle(method, parsed.path, query, headers, body)
                 except Exception as e:  # noqa: BLE001
                     result = 500, error_xml("InternalError", str(e), parsed.path)
+                if reader is not None and reader.left > 0:
+                    # refused before the body was consumed (auth/policy/
+                    # missing bucket): keep-alive framing is gone
+                    self.close_connection = True
                 if len(result) == 2:
                     status, payload = result
                     extra = {}

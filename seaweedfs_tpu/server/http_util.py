@@ -43,6 +43,28 @@ def relay_stream(handler, payload, declared_len: Optional[int] = None) -> None:
         handler.close_connection = True
 
 
+class CountedReader:
+    """Bounded view of a request body stream; tracks unconsumed bytes so
+    handlers know when keep-alive framing was abandoned (shared by the
+    WebDAV and S3 gateways' streaming uploads)."""
+
+    def __init__(self, rfile, length: int):
+        self._rfile = rfile
+        self.left = length
+
+    def read(self, n: int = -1) -> bytes:
+        if self.left <= 0:
+            return b""
+        want = self.left if n is None or n < 0 else min(n, self.left)
+        got = self._rfile.read(want)
+        self.left -= len(got)
+        return got
+
+    def drain(self) -> None:
+        while self.left > 0 and self.read(1 << 20):
+            pass
+
+
 class StreamBody:
     """Handler return value for incrementally-produced response bodies:
     `length` goes in Content-Length, `chunks` (an iterable of bytes) is

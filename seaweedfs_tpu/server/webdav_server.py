@@ -25,7 +25,7 @@ from http.server import BaseHTTPRequestHandler
 
 from ..filer.client import FilerClient
 from ..util.safe_xml import safe_fromstring
-from .http_util import relay_stream, start_server
+from .http_util import CountedReader, relay_stream, start_server
 
 DAV_NS = "DAV:"
 
@@ -56,23 +56,6 @@ class DavLock:
         return fp == self.path or (
             self.depth_infinity and fp.startswith(self.path.rstrip("/") + "/")
         )
-
-
-class _CountedReader:
-    """Bounded view of a request body stream; tracks unconsumed bytes so
-    the handler knows when keep-alive framing was abandoned."""
-
-    def __init__(self, rfile, length: int):
-        self._rfile = rfile
-        self.left = length
-
-    def read(self, n: int = -1) -> bytes:
-        if self.left <= 0:
-            return b""
-        want = self.left if n is None or n < 0 else min(n, self.left)
-        got = self._rfile.read(want)
-        self.left -= len(got)
-        return got
 
 
 def _rfc1123(ts: float) -> str:
@@ -552,7 +535,7 @@ class WebDavServer:
                 reader = None
                 if method == "PUT":
                     # stream PUT bodies straight through to the filer
-                    reader = _CountedReader(self.rfile, length)
+                    reader = CountedReader(self.rfile, length)
                     body = (reader, length)
                 else:
                     body = self.rfile.read(length) if length else b""
