@@ -21,7 +21,7 @@ import uuid
 from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler
 
-from ..server.http_util import CountedReader, relay_stream, start_server
+from ..server.http_util import CountedReader, drain_refused_body, relay_stream, start_server
 from . import auth as s3auth
 from . import policy_engine as pe
 from . import post_policy as pp
@@ -920,7 +920,9 @@ class S3ApiServer:
                     and sha in ("", s3auth.UNSIGNED_PAYLOAD)
                     and not query
                     and "X-Amz-Copy-Source" not in headers
-                    and parsed.path.count("/") >= 2  # /bucket/key, not /bucket
+                    # a real /bucket/key — '/bucket' and '/bucket/' are
+                    # bucket ops whose handlers never consume a body
+                    and parsed.path.rstrip("/").count("/") >= 2
                 ):
                     reader = CountedReader(self.rfile, length)
                     body = (reader, length)
@@ -931,9 +933,9 @@ class S3ApiServer:
                 except Exception as e:  # noqa: BLE001
                     result = 500, error_xml("InternalError", str(e), parsed.path)
                 if reader is not None and reader.left > 0:
-                    # refused before the body was consumed (auth/policy/
-                    # missing bucket): keep-alive framing is gone
-                    self.close_connection = True
+                    # refused before the body was consumed: bounded,
+                    # timeout-guarded drain (http_util.drain_refused_body)
+                    drain_refused_body(self, reader)
                 if len(result) == 2:
                     status, payload = result
                     extra = {}

@@ -65,6 +65,30 @@ class CountedReader:
             pass
 
 
+def drain_refused_body(handler, reader, cap: int = 32 << 20,
+                       timeout: float = 2.0) -> None:
+    """After refusing a request whose streamed body is unconsumed: drain a
+    bounded amount under a short socket timeout so modest in-flight bodies
+    still get their error response delivered on the keep-alive socket —
+    but a client that stalls (or never sends the body at all) can't wedge
+    the worker. Anything left after the cap/timeout drops the connection."""
+    old = handler.connection.gettimeout()
+    handler.connection.settimeout(timeout)
+    try:
+        while reader.left > 0 and cap > 0:
+            try:
+                got = reader.read(min(1 << 20, cap))
+            except OSError:  # includes socket.timeout
+                break
+            if not got:
+                break
+            cap -= len(got)
+    finally:
+        handler.connection.settimeout(old)
+    if reader.left > 0:
+        handler.close_connection = True
+
+
 class StreamBody:
     """Handler return value for incrementally-produced response bodies:
     `length` goes in Content-Length, `chunks` (an iterable of bytes) is

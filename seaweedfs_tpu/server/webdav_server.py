@@ -25,7 +25,7 @@ from http.server import BaseHTTPRequestHandler
 
 from ..filer.client import FilerClient
 from ..util.safe_xml import safe_fromstring
-from .http_util import CountedReader, relay_stream, start_server
+from .http_util import CountedReader, drain_refused_body, relay_stream, start_server
 
 DAV_NS = "DAV:"
 
@@ -552,9 +552,9 @@ class WebDavServer:
                     except Exception as e:  # noqa: BLE001
                         status, payload, extra = 500, str(e).encode(), {}
                 if reader is not None and reader.left > 0:
-                    # PUT refused before the body was consumed (423/405/...):
-                    # keep-alive framing is gone, drop the connection
-                    self.close_connection = True
+                    # refused before the body was consumed: bounded,
+                    # timeout-guarded drain (http_util.drain_refused_body)
+                    drain_refused_body(self, reader)
                 self.send_response(status)
                 streaming = hasattr(payload, "read")
                 clen = extra.pop("Content-Length-Override", None)
