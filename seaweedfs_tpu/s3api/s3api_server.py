@@ -57,6 +57,14 @@ def _iso(ts: float) -> str:
     )
 
 
+def _parse_copy_source(src: str) -> tuple[str, str]:
+    """X-Amz-Copy-Source → (bucket, key); either may come back empty for a
+    malformed header (s3api_object_copy_handlers.go pathToBucketAndObject)."""
+    src = urllib.parse.unquote(src)
+    sb, _, sk = src.lstrip("/").partition("/")
+    return sb, sk
+
+
 class S3ApiServer:
     def __init__(
         self,
@@ -262,10 +270,9 @@ class S3ApiServer:
         return 200, b"", {"ETag": f'"{r.get("eTag", "")}"'}
 
     def _copy_object(self, bucket, key, src):
-        src = urllib.parse.unquote(src)
-        if not src.startswith("/"):
-            src = "/" + src
-        sb, _, sk = src[1:].partition("/")
+        sb, sk = _parse_copy_source(src)
+        if not sb or not sk:
+            return _err("InvalidCopySource", src)
         status, data, _ = self.client.get_object(self._object_path(sb, sk))
         if status != 200:
             return _err("NoSuchKey", src)
@@ -286,6 +293,30 @@ class S3ApiServer:
         return 200, to_xml(
             "CopyObjectResult",
             {"ETag": f'"{r.get("eTag", "")}"', "LastModified": _iso(time.time())},
+        )
+
+    def _get_acl(self, bucket, key=None):
+        """Canned owner/FULL_CONTROL ACL for bucket and object ?acl probes.
+        The reference leaves ACL routes unimplemented (s3api_server.go:
+        108-117, commented out); SDKs that probe ACLs (boto3, rclone) still
+        need a well-formed AccessControlPolicy rather than a bucket listing,
+        so we serve the constant view — real access control is the IAM
+        policy layer."""
+        if not self._bucket_exists(bucket):
+            return _err("NoSuchBucket", bucket)
+        if key is not None:
+            entry = self.client.get_entry(self._object_path(bucket, key))
+            if entry is None or entry.get("is_directory"):
+                return _err("NoSuchKey", key)
+        owner = {"ID": "seaweedfs", "DisplayName": "seaweedfs"}
+        return 200, to_xml(
+            "AccessControlPolicy",
+            {
+                "Owner": owner,
+                "AccessControlList": {
+                    "Grant": {"Grantee": owner, "Permission": "FULL_CONTROL"}
+                },
+            },
         )
 
     def _get_object(self, bucket, key, headers, head=False):
@@ -438,6 +469,16 @@ class S3ApiServer:
         part = int(q["partNumber"])
         if self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/.info") is None:
             return _err("NoSuchUpload", upload_id)
+        if headers.get("X-Amz-Copy-Source"):
+            # UploadPartCopy: the part's bytes come from an existing object,
+            # not the request body (the reference routes this shape to a
+            # dedicated handler — s3api_server.go:61 → CopyObjectPartHandler)
+            return self._copy_part(
+                upload_id,
+                part,
+                headers["X-Amz-Copy-Source"],
+                headers.get("X-Amz-Copy-Source-Range", ""),
+            )
         if headers.get("X-Amz-Content-Sha256") == s3auth.STREAMING_PAYLOAD:
             try:
                 body = s3auth.decode_aws_chunked(
@@ -449,6 +490,50 @@ class S3ApiServer:
             f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part", body
         )
         return 200, b"", {"ETag": f'"{r.get("eTag", "")}"'}
+
+    def _copy_part(self, upload_id, part, src, rng):
+        """UploadPartCopy: server-side copy of (a range of) an existing
+        object into a multipart part (s3api_object_copy_handlers.go:84
+        CopyObjectPartHandler). The source streams filer→filer piecewise so
+        multi-GB parts copy in bounded gateway memory."""
+        sb, sk = _parse_copy_source(src)
+        if not sb or not sk:
+            return _err("InvalidCopySource", src)
+        src_path = self._object_path(sb, sk)
+        entry = self.client.get_entry(src_path)
+        if entry is None or entry.get("is_directory"):
+            return _err("InvalidCopySource", src)
+        status, resp, h = self.client.get_object_stream(src_path, rng=rng or None)
+        if status not in (200, 206):
+            if hasattr(resp, "close"):
+                resp.close()
+            return _err("InvalidCopySource", src)
+        if rng and status != 206:
+            # a Range the source ignored must not silently copy everything
+            resp.close()
+            return _err("InvalidRange", src)
+        clen = h.get("Content-Length")
+        if clen is None:
+            # a lengthless upstream would store a truncated/empty part and
+            # CompleteMultipartUpload would then assemble silent corruption;
+            # the filer always sends one, so fail loudly (same stance as
+            # _get_object)
+            resp.close()
+            return _err("InternalError", src)
+        length = int(clen)
+        try:
+            r = self.client.put_object_stream(
+                f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part", resp, length
+            )
+        finally:
+            resp.close()
+        return 200, to_xml(
+            "CopyPartResult",
+            {
+                "LastModified": _iso(time.time()),
+                "ETag": f'"{r.get("eTag", "")}"',
+            },
+        )
 
     def _complete_multipart(self, bucket, key, q, body):
         """Chunk-list concatenation, no data copy (filer_multipart.go
@@ -783,6 +868,34 @@ class S3ApiServer:
                 return False  # only an explicit policy Allow admits anonymous
             return identity is None or identity.can_do(action, bucket)
 
+        src_hdr = headers.get("X-Amz-Copy-Source", "")
+        if src_hdr and method == "PUT":
+            # copy sources are an independent READ of another resource: the
+            # destination-bucket write grant must not leak other tenants'
+            # bytes (or gateway-internal dirs like .uploads) through a copy
+            sb, sk = _parse_copy_source(src_hdr)
+            if not sb or not sk or sb.startswith("."):
+                return _err("InvalidCopySource", path)
+            src_pol = self._bucket_policy(sb)
+            verdict = None
+            if src_pol is not None:
+                verdict = pe.evaluate(
+                    src_pol,
+                    identity.access_key if identity else "",
+                    "s3:GetObject",
+                    pe.arn(sb, sk),
+                )
+            if verdict is None:
+                verdict = (
+                    not anonymous
+                    and (
+                        identity is None
+                        or identity.can_do(s3auth.ACTION_READ, sb)
+                    )
+                )
+            if not verdict:
+                return _err("AccessDenied", path)
+
         # ?policy subresource (PutBucketPolicy / GetBucketPolicy / Delete)
         if bucket and not key and "policy" in query:
             if self.iam.enabled and (
@@ -806,6 +919,12 @@ class S3ApiServer:
 
         if not key:
             if method == "PUT":
+                if "acl" in query:
+                    if not allowed(s3auth.ACTION_ADMIN):
+                        return _err("AccessDenied", path)
+                    if not self._bucket_exists(bucket):
+                        return _err("NoSuchBucket", bucket)
+                    return 200, b""  # accepted no-op, like GET ?acl's canned view
                 if not allowed(s3auth.ACTION_ADMIN, "s3:CreateBucket"):
                     return _err("AccessDenied", path)
                 return self._put_bucket(bucket)
@@ -830,6 +949,8 @@ class S3ApiServer:
             if method == "GET":
                 if not allowed(s3auth.ACTION_LIST):
                     return _err("AccessDenied", path)
+                if "acl" in query:
+                    return self._get_acl(bucket)
                 if "uploads" in query:
                     return self._list_uploads(bucket)
                 if "location" in query:
@@ -874,6 +995,22 @@ class S3ApiServer:
             if not allowed(s3auth.ACTION_READ, "s3:ListMultipartUploadParts"):
                 return _err("AccessDenied", path)
             return self._list_parts(bucket, key, query)
+        if "acl" in query:
+            # GET serves the canned owner view; PUT is an accepted no-op —
+            # either falling through would corrupt the object (PUT would
+            # store the ACL XML as the object body)
+            if method == "GET":
+                if not allowed(s3auth.ACTION_READ):
+                    return _err("AccessDenied", path)
+                return self._get_acl(bucket, key)
+            if method == "PUT":
+                if not allowed(s3auth.ACTION_WRITE):
+                    return _err("AccessDenied", path)
+                entry = self.client.get_entry(self._object_path(bucket, key))
+                if entry is None or entry.get("is_directory"):
+                    return _err("NoSuchKey", key)
+                return 200, b""
+            return _err("MethodNotAllowed", path)
         if method == "PUT":
             if not allowed(s3auth.ACTION_WRITE):
                 return _err("AccessDenied", path)

@@ -30,6 +30,7 @@ def free_port():
 IDENTITIES = [
     Identity("admin", "AKIAADMIN", "adminsecret", ["Admin"]),
     Identity("reader", "AKIAREAD", "readsecret", ["Read", "List"]),
+    Identity("writer", "AKIAWRITE", "writesecret", ["Write"]),
 ]
 
 
@@ -223,6 +224,137 @@ def test_copy_object(client):
     assert status == 200 and b"CopyObjectResult" in body
     status, data, _ = client.get_object("cp", "dst.txt")
     assert status == 200 and data == b"copy me"
+
+
+def test_upload_part_copy(client):
+    """Multipart server-side copy (boto3 upload_part_copy / rclone big-object
+    copies): object A copied part-by-part into object B must byte-compare
+    equal — the reference routes this at s3api_server.go:61."""
+    client.create_bucket("upc")
+    blob = bytes(range(256)) * 1024  # 256 KiB, multi-chunk at 64 KiB chunks
+    client.put_object("upc", "a.bin", blob)
+    status, body, _ = client.request("POST", "/upc/b.bin", query={"uploads": ""})
+    upload_id = find_text(parse_xml(body), "UploadId")
+    half = len(blob) // 2
+    ranges = [f"bytes=0-{half - 1}", f"bytes={half}-{len(blob) - 1}"]
+    for i, rng in enumerate(ranges, start=1):
+        status, body, _ = client.request(
+            "PUT",
+            "/upc/b.bin",
+            query={"partNumber": str(i), "uploadId": upload_id},
+            headers={
+                "X-Amz-Copy-Source": "/upc/a.bin",
+                "X-Amz-Copy-Source-Range": rng,
+            },
+        )
+        assert status == 200 and b"CopyPartResult" in body
+        assert find_text(parse_xml(body), "ETag")
+    complete = (
+        "<CompleteMultipartUpload>"
+        + "".join(
+            f"<Part><PartNumber>{i}</PartNumber></Part>" for i in (1, 2)
+        )
+        + "</CompleteMultipartUpload>"
+    ).encode()
+    status, _, _ = client.request(
+        "POST", "/upc/b.bin", query={"uploadId": upload_id}, body=complete
+    )
+    assert status == 200
+    status, data, _ = client.get_object("upc", "b.bin")
+    assert status == 200 and data == blob
+
+
+def test_upload_part_copy_whole_object(client):
+    """Part copy without a range takes the whole source object; a request
+    body sent alongside the copy header must be ignored, not stored (the
+    r4 silent-corruption bug)."""
+    client.create_bucket("upcw")
+    blob = b"whole-object-part " * 3000
+    client.put_object("upcw", "src", blob)
+    status, body, _ = client.request("POST", "/upcw/dst", query={"uploads": ""})
+    upload_id = find_text(parse_xml(body), "UploadId")
+    status, body, _ = client.request(
+        "PUT",
+        "/upcw/dst",
+        query={"partNumber": "1", "uploadId": upload_id},
+        headers={"X-Amz-Copy-Source": "/upcw/src"},
+        body=b"THIS BODY MUST NOT BECOME THE PART",
+    )
+    assert status == 200 and b"CopyPartResult" in body
+    status, _, _ = client.request(
+        "POST",
+        "/upcw/dst",
+        query={"uploadId": upload_id},
+        body=b"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+        b"</Part></CompleteMultipartUpload>",
+    )
+    assert status == 200
+    status, data, _ = client.get_object("upcw", "dst")
+    assert status == 200 and data == blob
+
+
+def test_upload_part_copy_bad_source(client):
+    client.create_bucket("upcb")
+    status, body, _ = client.request("POST", "/upcb/d", query={"uploads": ""})
+    upload_id = find_text(parse_xml(body), "UploadId")
+    status, body, _ = client.request(
+        "PUT",
+        "/upcb/d",
+        query={"partNumber": "1", "uploadId": upload_id},
+        headers={"X-Amz-Copy-Source": "/upcb/does-not-exist"},
+    )
+    assert status == 400 and b"InvalidCopySource" in body
+
+
+def test_copy_source_authorization(s3, client):
+    """A destination-bucket write grant must not leak other resources
+    through the copy path: the source is an independent READ that gets its
+    own authorization, and gateway-internal dirs are never valid sources."""
+    client.create_bucket("csa")
+    client.put_object("csa", "secret", b"classified")
+    writer = S3Client(f"http://{s3.url}", "AKIAWRITE", "writesecret")
+    status, body, _ = writer.request(
+        "PUT", "/csa/stolen", headers={"X-Amz-Copy-Source": "/csa/secret"}
+    )
+    assert status == 403 and b"AccessDenied" in body
+    # same gate on the multipart part-copy shape
+    status, body, _ = writer.request(
+        "PUT",
+        "/csa/stolen",
+        query={"partNumber": "1", "uploadId": "fake"},
+        headers={"X-Amz-Copy-Source": "/csa/secret"},
+    )
+    assert status == 403 and b"AccessDenied" in body
+    # internal dirs (.uploads holds other tenants' in-flight parts) are
+    # rejected outright, even for admin
+    status, body, _ = client.request(
+        "PUT", "/csa/grab", headers={"X-Amz-Copy-Source": "/.uploads/x/0001.part"}
+    )
+    assert status == 400 and b"InvalidCopySource" in body
+
+
+def test_get_acl(client):
+    """SDK ?acl probes get a well-formed AccessControlPolicy, not a bucket
+    listing (the reference comments these routes out at s3api_server.go:
+    108-117; we serve the canned owner view)."""
+    client.create_bucket("aclb")
+    client.put_object("aclb", "k", b"v")
+    status, body, _ = client.request("GET", "/aclb", query={"acl": ""})
+    assert status == 200
+    root = parse_xml(body)
+    assert root.tag.endswith("AccessControlPolicy")
+    assert find_text(root, "Permission") == "FULL_CONTROL"
+    status, body, _ = client.request("GET", "/aclb/k", query={"acl": ""})
+    assert status == 200 and b"FULL_CONTROL" in body
+    status, body, _ = client.request("GET", "/aclb/missing", query={"acl": ""})
+    assert status == 404 and b"NoSuchKey" in body
+    # PUT ?acl is an accepted no-op — it must never store the XML as data
+    status, _, _ = client.request(
+        "PUT", "/aclb/k", query={"acl": ""}, body=b"<AccessControlPolicy/>"
+    )
+    assert status == 200
+    status, data, _ = client.get_object("aclb", "k")
+    assert status == 200 and data == b"v"
 
 
 def test_tagging(client):
