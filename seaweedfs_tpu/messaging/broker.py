@@ -173,6 +173,11 @@ class Broker:
             return 404, {"error": str(e)}
         key = base64.b64decode(h.headers.get("X-Msg-Key", "") or "")
         ts = tp.publish(key, body)
+        if ts == 0:
+            # the buffer was discarded by a concurrent delete_topic: the
+            # message was dropped, and acking it as 200 would lie to the
+            # producer about durability
+            return 410, {"error": f"topic {ns}/{topic} deleted"}
         return 200, {"ts_ns": ts}
 
     # /sub/<ns>/<topic>/<partition>?since_ns=&limit=

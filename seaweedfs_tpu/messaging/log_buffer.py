@@ -69,6 +69,11 @@ class LogBuffer:
 
     def append(self, key: bytes, value: bytes) -> int:
         with self._lock:
+            if self._stop.is_set():
+                # a handler holding a stale partition reference (obtained
+                # before delete_topic evicted it) must not be able to seal
+                # new segments into a deleted tree — drop, signalled by 0
+                return 0
             ts = time.time_ns()
             if self._msgs and ts <= self._msgs[-1][0]:
                 ts = self._msgs[-1][0] + 1  # strictly monotonic per partition
@@ -143,6 +148,7 @@ class LogBuffer:
         with self._lock:
             self._msgs, self._buf = [], bytearray()
             self._prev = []
+            self.flush_fn = None  # no late _seal_locked may ever persist
             flushers = list(self._flushers)
             self._flushers = []
         for t in flushers:

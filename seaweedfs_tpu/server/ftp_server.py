@@ -13,6 +13,7 @@ NOOP, QUIT.
 
 from __future__ import annotations
 
+import shutil
 import socket
 import threading
 import time
@@ -256,17 +257,27 @@ class _Session(threading.Thread):
             # never serve that as file bytes
             self.send(550, "Not a plain file.")
             return
-        status, body, _ = self.srv.client.get_object(path)
+        status, body, h = self.srv.client.get_object_stream(path)
         if status != 200:
+            if hasattr(body, "close"):
+                body.close()
             self.send(550, "File not found.")
             return
         data = self._data_conn()
         if data is None:
+            body.close()
             return
-        self.send(150, f"Opening data connection for {arg} ({len(body)} bytes).")
+        size = h.get("Content-Length", "?")
+        self.send(150, f"Opening data connection for {arg} ({size} bytes).")
         try:
-            data.sendall(body)
+            # piecewise relay: downloads of any size in bounded memory
+            while True:
+                piece = body.read(1 << 20)
+                if not piece:
+                    break
+                data.sendall(piece)
         finally:
+            body.close()
             data.close()
         self.send(226, "Transfer complete.")
 
@@ -284,9 +295,14 @@ class _Session(threading.Thread):
         spool = tempfile.SpooledTemporaryFile(max_size=8 * 1024 * 1024)
         try:
             if append:
-                status, old, _ = self.srv.client.get_object(path)
+                # the existing object flows into the spool in bounded
+                # pieces — appending to a multi-GB file must not buffer it
+                status, old, _ = self.srv.client.get_object_stream(path)
                 if status == 200:
-                    spool.write(old)
+                    try:
+                        shutil.copyfileobj(old, spool, 1 << 20)
+                    finally:
+                        old.close()
             try:
                 while True:
                     buf = data.recv(65536)

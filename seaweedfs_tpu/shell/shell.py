@@ -74,7 +74,21 @@ def run_command_with_failover(env: CommandEnv, line: str) -> object:
 
     try:
         return run_command(env, line)
+    except (
+        FileNotFoundError,
+        PermissionError,
+        IsADirectoryError,
+        NotADirectoryError,
+    ):
+        # purely local filesystem failures (fs.meta.load/save paths) are
+        # not a failover and must not be rewrapped as "may have partially
+        # executed"
+        raise
     except (OSError, urllib.error.URLError) as e:
+        # everything else in the OSError hierarchy that the HTTP layer
+        # raises IS connection-level: ConnectionError subclasses, plain
+        # OSError(EHOSTUNREACH/ENETUNREACH) from connect(), socket.gaierror
+        # on DNS failure, socket.timeout
         cmd = (line.strip().split() or [""])[0]
         if not env.re_resolve_master():
             raise

@@ -490,3 +490,46 @@ def test_filer_reads_and_data_local_query_under_read_jwt(tmp_path):
         filer.stop()
         vs.stop()
         master.stop()
+
+
+# -- r4 advisor findings ------------------------------------------------------
+
+
+def test_log_buffer_discard_blocks_late_publish():
+    """A handler holding a partition reference across delete_topic must not
+    resurrect the topic as orphan segments: after discard(), append() drops
+    and no flush function may ever run again (ADVICE r4 #1)."""
+    from seaweedfs_tpu.messaging.log_buffer import LogBuffer
+
+    flushed = []
+    lb = LogBuffer(
+        flush_fn=lambda s, e, b: flushed.append(b), flush_bytes=64
+    )
+    assert lb.append(b"k", b"v") > 0
+    lb.discard()
+    # late publish through the stale reference: dropped, not buffered
+    assert lb.append(b"k2", b"x" * 200) == 0  # would cross flush_bytes
+    lb.flush()
+    time.sleep(0.1)
+    assert flushed == []
+
+
+def test_shell_failover_ignores_local_oserror(tmp_path):
+    """A purely local OSError (missing fs.meta file) must surface as-is —
+    not trigger master re-resolution or the 'may have partially executed'
+    rewrap (ADVICE r4 #2)."""
+    from seaweedfs_tpu.shell.shell import run_command_with_failover
+    from seaweedfs_tpu.shell.commands import CommandEnv
+
+    class Env(CommandEnv):
+        def __init__(self):
+            self.master = "127.0.0.1:1"
+            self.filer = ""
+
+        def re_resolve_master(self):
+            raise AssertionError("local failure escalated to failover")
+
+    with pytest.raises(FileNotFoundError):
+        run_command_with_failover(
+            Env(), f"fs.meta.load -i={tmp_path}/does-not-exist.meta"
+        )

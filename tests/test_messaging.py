@@ -201,3 +201,26 @@ def test_delete_topic_under_write_no_resurrection(stack):
     mc.publish("r", "hot", b"reborn", partition=0)
     msgs, _ = mc.fetch("r", "hot", 0)
     assert any(m["value"] == b"reborn" for m in msgs)
+
+
+def test_publish_after_discard_is_not_acked(stack):
+    """The delete-race window: a handler that resolved its TopicPartition
+    before delete_topic discarded the buffer must get an error, not a 200
+    ack for a dropped message (ADVICE r5: append()'s 0 sentinel must not
+    leak out as ts_ns)."""
+    brokers, _ = stack
+    broker = brokers[0]
+    broker.topics.create_topic("race", "gone", partitions=1)
+    tp = broker.topics.get_partition("race", "gone", 0)
+    broker.topics.delete_topic("race", "gone")
+
+    class H:  # minimal handler stub: _h_pub only reads .headers
+        headers = {}
+
+    orig = broker.topics.get_partition
+    broker.topics.get_partition = lambda *a: tp  # the stale reference
+    try:
+        status, resp = broker._h_pub(H(), "/pub/race/gone/0", {}, b"late")
+    finally:
+        broker.topics.get_partition = orig
+    assert status == 410 and "deleted" in resp["error"]
