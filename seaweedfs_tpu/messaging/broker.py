@@ -174,10 +174,12 @@ class Broker:
         key = base64.b64decode(h.headers.get("X-Msg-Key", "") or "")
         ts = tp.publish(key, body)
         if ts == 0:
-            # the buffer was discarded by a concurrent delete_topic: the
-            # message was dropped, and acking it as 200 would lie to the
-            # producer about durability
-            return 410, {"error": f"topic {ns}/{topic} deleted"}
+            # the message was dropped, and acking it as 200 would lie to
+            # the producer about durability. 410 only for a real deletion;
+            # a broker mid-shutdown is retryable → 503
+            if tp.buffer.discarded:
+                return 410, {"error": f"topic {ns}/{topic} deleted"}
+            return 503, {"error": "broker shutting down, retry"}
         return 200, {"ts_ns": ts}
 
     # /sub/<ns>/<topic>/<partition>?since_ns=&limit=

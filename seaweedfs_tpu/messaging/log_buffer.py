@@ -64,6 +64,7 @@ class LogBuffer:
         self._flushers: list[threading.Thread] = []
         self._last_flush = time.monotonic()
         self._stop = threading.Event()
+        self.discarded = False  # True only via discard() (topic deletion)
         self._ticker = threading.Thread(target=self._tick, daemon=True)
         self._ticker.start()
 
@@ -146,6 +147,7 @@ class LogBuffer:
         the topic tree is removed would resurrect it as orphan segments."""
         self._stop.set()
         with self._lock:
+            self.discarded = True
             self._msgs, self._buf = [], bytearray()
             self._prev = []
             self.flush_fn = None  # no late _seal_locked may ever persist

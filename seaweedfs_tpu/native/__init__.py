@@ -42,6 +42,8 @@ class _Lib:
             ctypes.c_char_p,
             ctypes.c_size_t,
         ]
+        self._c.sweed_kernel_variant.restype = ctypes.c_char_p
+        self._c.sweed_kernel_variant.argtypes = []
         self._c.sweed_rs_matmul.restype = None
         self._c.sweed_rs_matmul.argtypes = [
             ctypes.c_void_p,  # matrix
@@ -54,6 +56,10 @@ class _Lib:
 
     def crc32c_update(self, crc: int, data: bytes) -> int:
         return self._c.sweed_crc32c_update(crc, data, len(data))
+
+    def kernel_variant(self) -> str:
+        """Which rs_matmul path this build compiled in ('avx2'/'scalar')."""
+        return self._c.sweed_kernel_variant().decode()
 
     def rs_matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
         """(out_rows×k GF matrix) @ (k×n bytes) → (out_rows×n bytes)."""

@@ -178,4 +178,15 @@ void sweed_xor_bytes(uint8_t* dst, const uint8_t* src, size_t n) {
   for (size_t j = 0; j < n; j++) dst[j] ^= src[j];
 }
 
+// Which rs_matmul inner loop this build compiled in — benches record it so
+// a published CPU-fallback number can never silently come from the wrong
+// kernel (the r4 artifact had 0.028 GB/s with no way to tell why).
+const char* sweed_kernel_variant(void) {
+#if defined(__AVX2__)
+  return "avx2";
+#else
+  return "scalar";
+#endif
+}
+
 }  // extern "C"

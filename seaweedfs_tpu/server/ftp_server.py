@@ -269,6 +269,7 @@ class _Session(threading.Thread):
             return
         size = h.get("Content-Length", "?")
         self.send(150, f"Opening data connection for {arg} ({size} bytes).")
+        sent = 0
         try:
             # piecewise relay: downloads of any size in bounded memory
             while True:
@@ -276,9 +277,15 @@ class _Session(threading.Thread):
                 if not piece:
                     break
                 data.sendall(piece)
+                sent += len(piece)
         finally:
             body.close()
             data.close()
+        if size != "?" and sent != int(size):
+            # a premature upstream close surfaces as EOF on read(), not an
+            # exception — a truncated transfer must never be acked as 226
+            self.send(451, f"Transfer aborted: got {sent} of {size} bytes.")
+            return
         self.send(226, "Transfer complete.")
 
     def _store(self, arg, append: bool):
@@ -297,12 +304,20 @@ class _Session(threading.Thread):
             if append:
                 # the existing object flows into the spool in bounded
                 # pieces — appending to a multi-GB file must not buffer it
-                status, old, _ = self.srv.client.get_object_stream(path)
+                status, old, oh = self.srv.client.get_object_stream(path)
                 if status == 200:
                     try:
                         shutil.copyfileobj(old, spool, 1 << 20)
                     finally:
                         old.close()
+                    want = oh.get("Content-Length")
+                    if want is not None and spool.tell() != int(want):
+                        # upstream died mid-read: EOF, not an exception —
+                        # storing the truncated prefix would be silent
+                        # data loss behind a 226
+                        data.close()
+                        self.send(451, "Append aborted: source read truncated.")
+                        return
             try:
                 while True:
                     buf = data.recv(65536)

@@ -201,3 +201,12 @@ def test_budgeted_chunk_caps_against_free_hbm():
         pass
 
     assert _budgeted_chunk(NoStats(), 8 << 20, 14) == 8 << 20
+
+
+def test_native_kernel_reports_variant():
+    """The native lib self-reports which rs_matmul inner loop compiled in,
+    so bench artifacts can distinguish a stale/slow build from a host
+    without AVX2 (BENCH r4 recorded 0.028 GB/s with no provenance)."""
+    from seaweedfs_tpu.native import lib
+
+    assert lib.kernel_variant() in ("avx2", "scalar")
