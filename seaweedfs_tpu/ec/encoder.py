@@ -199,12 +199,26 @@ def _read_item(f, item, k: int, dat_size: int) -> tuple[np.ndarray, bool]:
     return np.ascontiguousarray(mat), True
 
 
+def _depth_chunk(chunk: int, total_width: int, floor: int, depth: int = 8) -> int:
+    """Shrink the per-item column width so the overlap pipeline gets ~depth
+    items: a 128 MB volume under the default 32 MB chunk collapses to ONE
+    work item, and a single item overlaps nothing — r4's e2e efficiency was
+    pinned at ~0.65 by exactly this (wall = read + H2D + kernel + D2H,
+    serial). Rounds up to `floor` (the alignment/batching granularity) and
+    never grows past the budgeted `chunk`; big volumes (total/depth ≥
+    chunk) are unaffected."""
+    target = -(-total_width // depth)
+    target = max(floor, -(-target // floor) * floor)
+    return max(min(chunk, target), min(chunk, floor))
+
+
 def _budgeted_chunk(codec, chunk: int, device_streams: int) -> int:
     """Cap the column-chunk size against free device memory.
 
-    The overlap pipeline keeps ≤3 chunks in flight (2 queue slots + the one
-    in compute), each holding ~device_streams×chunk bytes in HBM (k input
-    rows staged + output rows produced). The chip may be shared, so only a
+    The overlap pipeline keeps ≤3 chunks device-resident (one in compute,
+    one in the fetch queue, one mid-fetch), each holding
+    ~device_streams×chunk bytes in HBM (k input rows staged + output rows
+    produced). The chip may be shared, so only a
     quarter of the reported free pool is budgeted; oversized chunks are
     split rather than dying with RESOURCE_EXHAUSTED (VERDICT r3 weak #1).
     Codecs without allocator stats (CPU) keep the requested chunk."""
@@ -238,11 +252,18 @@ def write_ec_files(
     """
     codec = codec or get_codec()
     k, m = codec.data_shards, codec.parity_shards
-    chunk = chunk_bytes or getattr(codec, "chunk_bytes", 8 * 1024 * 1024)
-    chunk = _budgeted_chunk(codec, chunk, k + m)
-
     dat = base_file_name + ".dat"
     dat_size = os.path.getsize(dat)
+    if chunk_bytes is not None:
+        # explicit chunk: the caller owns the plan (bench warms kernel
+        # shapes against a precomputed item list — re-deriving here could
+        # drift if device_memory_free moved between the two readings)
+        chunk = chunk_bytes
+    else:
+        chunk = getattr(codec, "chunk_bytes", 8 * 1024 * 1024)
+        chunk = _budgeted_chunk(codec, chunk, k + m)
+        if hasattr(codec, "matmul_device") and chunk >= small_block_size:
+            chunk = _depth_chunk(chunk, -(-dat_size // k), small_block_size)
     items = _work_items(dat_size, k, large_block_size, small_block_size, chunk)
 
     outputs = [open(base_file_name + shard_ext(i), "wb") for i in range(k + m)]
@@ -277,28 +298,41 @@ def write_ec_files(
             o.close()
 
 
-def _overlap_pipeline(produce, compute, consume, stats: Optional[dict] = None) -> None:
-    """Three-stage overlap shared by encode and rebuild: a reader thread
+def _overlap_pipeline(produce, compute, consume, fetch=None,
+                      stats: Optional[dict] = None) -> None:
+    """Four-stage overlap shared by encode and rebuild: a reader thread
     runs `produce` (an iterator of host chunks), the main thread runs
-    `compute` (async device dispatch), a writer thread runs `consume`
-    (blocks on device results, writes files). Bounded queues give ~2
-    chunks of lookahead; any stage failing drains the others so every
+    `compute` (async device dispatch: H2D + kernel launch), a fetch thread
+    runs `fetch` (blocks on device results — the D2H leg), and a writer
+    thread runs `consume` (writes files). Bounded queues give ~2 chunks of
+    lookahead per edge; any stage failing drains the others so every
     thread exits and the first error is re-raised.
+
+    The dedicated fetch leg is what lets H2D of chunk i+1 ride the link
+    concurrently with D2H of chunk i (the transfer directions are
+    independent); folding the blocking D2H into the writer (the r4 shape)
+    left dispatch serialized behind it — wall was ~1.5× the slowest stage
+    even with writes discarded. ``fetch=None`` degrades to the 3-stage
+    form for host-only callers.
 
     With a ``stats`` dict, per-stage BUSY time (time inside the stage
     callable, excluding queue blocking) and wall time are recorded, plus
     ``efficiency`` = max(stage busy) / wall — 1.0 means the slowest stage
-    fully hides the other two, i.e. wall ≈ max(stage) rather than
-    Σ(stages), which is the whole point vs the reference's serial
-    read→Encode→write loop (ec_encoder.go:162-192)."""
+    fully hides the others, i.e. wall ≈ max(stage) rather than Σ(stages),
+    which is the whole point vs the reference's serial read→Encode→write
+    loop (ec_encoder.go:162-192)."""
     import queue
     import threading
     import time as _time
 
+    # one-slot mid/out queues: enough lookahead for compute(i+1) to ride
+    # the link concurrently with fetch(i), without tripling the chunks of
+    # host+device memory the pipeline keeps resident
     read_q: queue.Queue = queue.Queue(maxsize=2)
-    write_q: queue.Queue = queue.Queue(maxsize=2)
+    fetch_q: queue.Queue = queue.Queue(maxsize=1)
+    write_q: queue.Queue = queue.Queue(maxsize=1)
     errors: list[BaseException] = []
-    busy = {"read": 0.0, "compute": 0.0, "write": 0.0}
+    busy = {"read": 0.0, "compute": 0.0, "fetch": 0.0, "write": 0.0}
     t_wall = _time.perf_counter()
 
     def reader():
@@ -316,6 +350,23 @@ def _overlap_pipeline(produce, compute, consume, stats: Optional[dict] = None) -
         finally:
             read_q.put(None)
 
+    def fetcher():
+        try:
+            while True:
+                got = fetch_q.get()
+                if got is None:
+                    return
+                t0 = _time.perf_counter()
+                out = fetch(got)
+                busy["fetch"] += _time.perf_counter() - t0
+                write_q.put(out)
+        except BaseException as e:
+            errors.append(e)
+            while fetch_q.get() is not None:  # drain so the feeder unblocks
+                pass
+        finally:
+            write_q.put(None)
+
     def writer():
         try:
             while True:
@@ -330,10 +381,14 @@ def _overlap_pipeline(produce, compute, consume, stats: Optional[dict] = None) -
             while write_q.get() is not None:  # drain so the feeder unblocks
                 pass
 
+    mid_q = fetch_q if fetch is not None else write_q
     rt = threading.Thread(target=reader, daemon=True)
     wt = threading.Thread(target=writer, daemon=True)
+    ft = threading.Thread(target=fetcher, daemon=True) if fetch is not None else None
     rt.start()
     wt.start()
+    if ft is not None:
+        ft.start()
     try:
         while True:
             got = read_q.get()
@@ -345,11 +400,13 @@ def _overlap_pipeline(produce, compute, consume, stats: Optional[dict] = None) -
                 t0 = _time.perf_counter()
                 out = compute(got)
                 busy["compute"] += _time.perf_counter() - t0
-                write_q.put(out)
+                mid_q.put(out)
             except BaseException as e:
                 errors.append(e)
     finally:
-        write_q.put(None)
+        mid_q.put(None)
+        if ft is not None:
+            ft.join()  # fetcher forwards its None to write_q on exit
         wt.join()
         # unblock the reader if it is mid-put (main loop exited early)
         while rt.is_alive():
@@ -366,6 +423,7 @@ def _overlap_pipeline(produce, compute, consume, stats: Optional[dict] = None) -
             wall_s=wall,
             read_busy_s=busy["read"],
             compute_busy_s=busy["compute"],
+            fetch_busy_s=busy["fetch"],
             write_busy_s=busy["write"],
             efficiency=max(busy.values()) / wall if wall > 0 else 0.0,
         )
@@ -395,25 +453,32 @@ def _encode_pipelined(dat, items, codec, outputs, dat_size: int,
         )
         return width, data, parity_dev
 
-    def consume(got):
+    def fetch(got):
         width, data, parity_dev = got
         if parity_dev is None:
+            return width, data, None
+        # the blocking D2H leg: overlaps the next chunk's H2D + dispatch
+        return width, data, np.asarray(parity_dev)
+
+    def consume(got):
+        width, data, parity = got
+        if parity is None:
             for o in outputs:  # keep sparse regions sparse (holes)
                 o.seek(width, 1)
             return
-        parity = np.asarray(parity_dev)[:, :width]  # blocks until ready
         for i in range(k):
             outputs[i].write(data[i, :width].tobytes())
         for j in range(m):
-            outputs[k + j].write(parity[j].tobytes())
+            outputs[k + j].write(parity[j, :width].tobytes())
 
-    _overlap_pipeline(produce, compute, consume, stats=stats)
+    _overlap_pipeline(produce, compute, consume, fetch=fetch, stats=stats)
 
 
 def rebuild_ec_files(
     base_file_name: str,
     codec: Optional[Codec] = None,
     chunk_bytes: Optional[int] = None,
+    pipeline_stats: Optional[dict] = None,
 ) -> list[int]:
     """Regenerate missing shard files from ≥k present ones
     (RebuildEcFiles / generateMissingEcFiles, :61,95). Returns generated ids."""
@@ -446,8 +511,11 @@ def rebuild_ec_files(
     outs = {sid: open(base_file_name + shard_ext(sid), "wb") for sid in missing}
     try:
         if hasattr(codec, "matmul_device"):
+            align = codec.alignment() if hasattr(codec, "alignment") else 1
             _rebuild_pipelined(
-                codec, ins, outs, missing, shard_size, chunk
+                codec, ins, outs, missing, shard_size,
+                _depth_chunk(chunk, shard_size, align),
+                stats=pipeline_stats,
             )
         else:
             pos = 0
@@ -508,7 +576,8 @@ def _rebuild_rows(codec, present_ids: list[int], missing: list[int]) -> np.ndarr
     return np.vstack(blocks)
 
 
-def _rebuild_pipelined(codec, ins, outs, missing, shard_size, chunk) -> None:
+def _rebuild_pipelined(codec, ins, outs, missing, shard_size, chunk,
+                       stats: Optional[dict] = None) -> None:
     """Overlap disk reads, H2D staging + device matmul, and shard writes —
     the encode pipeline's shape applied to rebuild (the serial
     read→reconstruct→write loop leaves the device idle during IO)."""
@@ -542,17 +611,22 @@ def _rebuild_pipelined(codec, ins, outs, missing, shard_size, chunk) -> None:
             return width, None  # zeros reconstruct to zeros
         return width, codec.matmul_device(rows, codec.device_put(buf))
 
-    def consume(got):
+    def fetch(got):
         width, out_dev = got
         if out_dev is None:
+            return width, None
+        return width, np.asarray(out_dev)  # blocking D2H leg
+
+    def consume(got):
+        width, out = got
+        if out is None:
             for sid in missing:
                 outs[sid].seek(width, 1)
             return
-        out = np.asarray(out_dev)[:, :width]  # blocks until ready
         for j, sid in enumerate(missing):
-            outs[sid].write(out[j].tobytes())
+            outs[sid].write(out[j, :width].tobytes())
 
-    _overlap_pipeline(produce, compute, consume)
+    _overlap_pipeline(produce, compute, consume, fetch=fetch, stats=stats)
 
 
 # -- .ecx sorted index -------------------------------------------------------

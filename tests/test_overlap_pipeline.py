@@ -74,3 +74,77 @@ def test_stats_on_real_encode(tmp_path):
     assert stats["wall_s"] > 0
     assert {"read_busy_s", "compute_busy_s", "write_busy_s",
             "efficiency"} <= set(stats)
+
+
+def test_four_leg_overlap_hides_dispatch_behind_fetch():
+    """The r5 shape: a dedicated fetch (D2H) leg must let the compute
+    (H2D+dispatch) stage of chunk i+1 run concurrently with the fetch of
+    chunk i — wall ≈ max(stage), with all four busy legs accounted."""
+    stats: dict = {}
+    n, tc, tf = 8, 0.02, 0.06
+
+    def produce():
+        yield from range(n)
+
+    def compute(x):
+        time.sleep(tc)
+        return x
+
+    def fetch(x):
+        time.sleep(tf)  # the dominant leg (slow-link D2H)
+        return x
+
+    def consume(x):
+        pass
+
+    _overlap_pipeline(produce, compute, consume, fetch=fetch, stats=stats)
+    serial = n * (tc + tf)
+    assert stats["fetch_busy_s"] >= n * tf * 0.9
+    assert stats["wall_s"] < 0.9 * serial, stats
+    assert stats["efficiency"] >= 0.7, stats
+
+
+def test_fetch_leg_error_propagates():
+    def produce():
+        yield from range(5)
+
+    def compute(x):
+        return x
+
+    def fetch(x):
+        if x == 2:
+            raise RuntimeError("boom in fetch")
+        return x
+
+    seen = []
+
+    def consume(x):
+        seen.append(x)
+
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="boom in fetch"):
+        _overlap_pipeline(produce, compute, consume, fetch=fetch)
+
+
+def test_depth_chunk_splits_small_volumes():
+    """A 128 MB volume under a 32 MB budget previously collapsed to one
+    work item — nothing to overlap (r4 efficiency pinned at ~0.65). The
+    depth-aware chunk yields several items while leaving big volumes at
+    the full budgeted chunk."""
+    from seaweedfs_tpu.ec.encoder import (
+        LARGE_BLOCK_SIZE,
+        SMALL_BLOCK_SIZE,
+        _depth_chunk,
+        _work_items,
+    )
+
+    mb = 1024 * 1024
+    per_shard = -(-128 * mb // 10)
+    chunk = _depth_chunk(32 * mb, per_shard, SMALL_BLOCK_SIZE)
+    items = _work_items(128 * mb, 10, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, chunk)
+    assert len(items) >= 4, (chunk, len(items))
+    # big volumes: unchanged
+    assert _depth_chunk(32 * mb, 3 * 1024 * mb, SMALL_BLOCK_SIZE) == 32 * mb
+    # floor: never below one small block (or the budget, if smaller)
+    assert _depth_chunk(32 * mb, 2 * mb, SMALL_BLOCK_SIZE) == SMALL_BLOCK_SIZE
