@@ -582,6 +582,40 @@ def main() -> None:
         else:
             tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
             log(f"smallfile probe failed: {tail[0][:140]}")
+        # full reference scale: the exact workload behind BASELINE.md's
+        # 15,708 w/s / 47,019 r/s (benchmark.go:71-75 defaults, n=1048576).
+        # The quick n=10k run above keeps signal on constrained hosts; the
+        # full run is attempted whenever the quick run passed and the time
+        # budget allows (~45-60s of actual pump wall at measured rates).
+        # measured ~61s wall on this host (write+read phases ~45s); gate on
+        # the PROJECTED duration from the quick run's measured rates, so a
+        # constrained host doesn't burn the full subprocess timeout
+        projected_s = (
+            1048576 / max(smallfile["write"]["rps"], 1)
+            + 1048576 / max(smallfile["read"]["rps"], 1)
+            if smallfile else float("inf")
+        )
+        if smallfile and projected_s < 600 \
+                and time.perf_counter() - t_setup < 1500:
+            rf = _run_probe(["--probe-smallfile", "1048576", "16"],
+                            timeout=900)
+            if rf.returncode == 0 and rf.stdout.strip():
+                full = json.loads(rf.stdout.strip().splitlines()[-1])
+                full["note"] = (
+                    "FULL reference scale: 1,048,576 × 1KB files, c=16 "
+                    "(benchmark.go defaults); baseline 15,708 w/s / "
+                    "47,019 r/s"
+                )
+                smallfile["full_scale"] = full
+                log(
+                    f"smallfile FULL n=1048576: write "
+                    f"{full['write']['rps']} req/s (failed "
+                    f"{full['write']['failed']}); read {full['read']['rps']} "
+                    f"req/s (failed {full['read']['failed']})"
+                )
+            else:
+                tailf = (rf.stderr or "").strip().splitlines()[-1:] or [""]
+                log(f"smallfile full-scale run failed: {tailf[0][:140]}")
     except subprocess.TimeoutExpired:
         log("smallfile probe timed out")
 

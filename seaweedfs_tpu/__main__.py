@@ -998,6 +998,106 @@ def cmd_diff_servers(args):
         raise SystemExit(1)
 
 
+def cmd_change_superblock(args):
+    """Edit the replication/TTL bytes of a sealed volume's superblock in
+    place — the change_superblock analog (`unmaintained/change_superblock/
+    change_superblock.go:41`). With no -replication/-ttl it only prints the
+    current settings. The volume server holding this .dat must be stopped
+    first (same operational contract as the reference; step 3 there is
+    'restart volume servers')."""
+    from .storage.replica_placement import ReplicaPlacement
+    from .storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
+    from .storage.ttl import read_ttl
+    from .storage.volume import volume_file_name
+
+    base = volume_file_name(args.dir, args.collection, args.volume_id)
+    with open(base + ".dat", "r+b") as f:
+        # extra_size is a u16; from_bytes slices exactly what the header
+        # declares, so over-reading its maximum is always safe
+        sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE + 0xFFFF))
+        print(f"Current Volume Replication: {sb.replica_placement}")
+        print(f"Current Volume TTL: {sb.ttl}")
+        changed = False
+        if args.replication:
+            sb.replica_placement = ReplicaPlacement.from_string(args.replication)
+            print(f"Changing replication to: {sb.replica_placement}")
+            changed = True
+        if args.ttl:
+            sb.ttl = read_ttl(args.ttl)
+            print(f"Changing ttl to: {sb.ttl}")
+            changed = True
+        if changed:
+            blob = sb.to_bytes()
+            # replication/TTL live in the fixed 8-byte header; the extra
+            # section is untouched, so the record layout cannot shift
+            assert len(blob) == sb.block_size()
+            f.seek(0)
+            f.write(blob)
+            print("Done.")
+
+
+def cmd_volume_tail(args):
+    """Follow a live volume's appended needles — the volume_tailer analog
+    (`unmaintained/volume_tailer/volume_tailer.go:24`): '+' lines for
+    writes, '-' for tombstones; -showTextFile prints textual bodies.
+    -rewind=-1 starts from the first entry, 0 from now, N seconds back
+    otherwise. Stops after -timeoutSeconds without activity (0 = follow
+    forever)."""
+    import time as _time
+
+    from . import operation
+    from .server.http_util import http_bytes_headers
+    from .storage.volume_backup import parse_tail_frames
+    from .util import compression
+
+    locs = operation.lookup(args.master, args.volume_id)
+    if not locs:
+        raise SystemExit(f"volume {args.volume_id} not found on any server")
+    src = locs[0]["url"]
+    if args.rewind < 0:
+        since = 0
+    elif args.rewind == 0:
+        since = _time.time_ns()
+    else:
+        since = _time.time_ns() - int(args.rewind * 1e9)
+    idle_start = _time.monotonic()
+    while True:
+        status, blob, headers = http_bytes_headers(
+            "GET",
+            f"http://{src}/admin/tail?volume={args.volume_id}"
+            f"&since_ns={since}",
+        )
+        if status != 200:
+            raise SystemExit(f"tail {src}: HTTP {status}")
+        if blob:
+            idle_start = _time.monotonic()
+            version = int(headers.get("X-Volume-Version", "3"))
+            for n in parse_tail_frames(blob, version):
+                mark = "-" if n.size <= 0 else "+"
+                print(
+                    f"{mark} {args.volume_id},{n.id:x}{n.cookie:08x} "
+                    f"size {max(n.size, 0)} appendedAt {n.append_at_ns}"
+                )
+                if args.show_text and n.size > 0:
+                    data = n.data
+                    if n.is_compressed:
+                        try:
+                            data = compression.ungzip_data(data)
+                        except Exception:  # noqa: BLE001 — display only
+                            continue
+                    try:
+                        print(data.decode("utf-8"))
+                    except UnicodeDecodeError:
+                        pass
+            since = int(headers.get("X-Last-Append-Ns", since))
+        else:
+            if args.timeout_seconds and (
+                _time.monotonic() - idle_start > args.timeout_seconds
+            ):
+                return
+            _time.sleep(args.poll_interval)
+
+
 def cmd_fix(args):
     """Re-create a volume's .idx from its .dat (`weed fix`, command/fix.go)."""
     from .storage.volume import Volume, volume_file_name
@@ -1402,6 +1502,36 @@ def main(argv=None):
     ds.add_argument("-offsetSize", dest="offset_size", type=int, default=4,
                     choices=[4, 5])
     ds.set_defaults(fn=cmd_diff_servers)
+
+    cs = sub.add_parser(
+        "change.superblock",
+        help="edit replication/TTL bits of a sealed .dat in place "
+        "(change_superblock analog)",
+    )
+    cs.add_argument("-dir", default=".")
+    cs.add_argument("-collection", default="")
+    cs.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    cs.add_argument("-replication", default="",
+                    help="target xyz replication; empty = print only")
+    cs.add_argument("-ttl", default="",
+                    help="target TTL (e.g. 3d); empty = print only")
+    cs.set_defaults(fn=cmd_change_superblock)
+
+    vt = sub.add_parser(
+        "volume.tail",
+        help="follow a live volume's appended needles (volume_tailer analog)",
+    )
+    vt.add_argument("-master", default="127.0.0.1:9333")
+    vt.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    vt.add_argument("-rewind", type=float, default=-1,
+                    help="seconds to rewind; -1 = from first entry, 0 = now")
+    vt.add_argument("-timeoutSeconds", dest="timeout_seconds", type=float,
+                    default=0, help="stop after this idle time (0 = forever)")
+    vt.add_argument("-showTextFile", dest="show_text", action="store_true",
+                    help="display textual file content")
+    vt.add_argument("-pollInterval", dest="poll_interval", type=float,
+                    default=1.0)
+    vt.set_defaults(fn=cmd_volume_tail)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=cmd_version)

@@ -1,14 +1,24 @@
 """Messaging client library (reference `messaging/msgclient/`): publisher
-with consistent-hash partition→broker routing, poll-based subscriber."""
+with consistent-hash partition→broker routing, poll-based subscriber, and
+the channel layer (`chan_pub.go:15` PubChannel / `chan_sub.go:16`
+SubChannel) — named one-partition streams under the reserved "chan"
+namespace with md5 integrity accumulators and an in-band close marker."""
 
 from __future__ import annotations
 
 import base64
+import hashlib
 import time
 from typing import Iterator, Optional
 
 from ..server.http_util import http_bytes, http_json
 from .consistent import ConsistentRing
+
+# the reference marks end-of-channel with Message.IsClose (chan_pub.go:55);
+# this wire carries key+value, so a reserved key is the close marker — keys
+# beginning with NUL are not constructible through the channel Publish API
+_CHAN_NS = "chan"
+_CLOSE_KEY = b"\x00chan.close"
 
 
 class MessagingClient:
@@ -55,9 +65,9 @@ class MessagingClient:
         key: bytes = b"",
         partition: Optional[int] = None,
     ) -> int:
-        conf = self.topic_conf(ns, topic)
-        n = conf.get("partitions", 1)
         if partition is None:
+            conf = self.topic_conf(ns, topic)
+            n = conf.get("partitions", 1)
             partition = (hash(key) if key else time.monotonic_ns()) % n
         broker = self._broker_for(ns, topic, partition)
         import urllib.request
@@ -98,6 +108,20 @@ class MessagingClient:
         ]
         return msgs, d.get("last_ts_ns", since_ns)
 
+    # -- channels (msgclient/chan_pub.go, chan_sub.go) -----------------------
+    def new_pub_channel(self, chan_name: str) -> "PubChannel":
+        """NewPubChannel (chan_pub.go:21): a named single-partition stream
+        under the reserved "chan" namespace."""
+        self.create_topic(_CHAN_NS, chan_name, partitions=1)
+        return PubChannel(self, chan_name)
+
+    def new_sub_channel(self, subscriber_id: str, chan_name: str) -> "SubChannel":
+        """NewSubChannel (chan_sub.go:23). `subscriber_id` names the
+        consumer for diagnostics (the poll transport needs no server-side
+        registration)."""
+        self.create_topic(_CHAN_NS, chan_name, partitions=1)
+        return SubChannel(self, subscriber_id, chan_name)
+
     def subscribe(
         self,
         ns: str,
@@ -123,3 +147,63 @@ class MessagingClient:
                 ):
                     return
                 time.sleep(poll_interval)
+
+
+class PubChannel:
+    """Write side of a named channel (chan_pub.go:15): every Publish lands
+    on partition 0 of chan/<name>, an md5 accumulates over published bytes
+    (the reference's transfer-integrity check), and close() sends the
+    in-band close marker that ends the far side's iteration."""
+
+    def __init__(self, mc: MessagingClient, name: str):
+        self._mc = mc
+        self.name = name
+        self._md5 = hashlib.md5()
+        self._closed = False
+
+    def publish(self, value: bytes) -> int:
+        if self._closed:
+            raise ValueError(f"channel {self.name} is closed")
+        ts = self._mc.publish(_CHAN_NS, self.name, value, partition=0)
+        self._md5.update(value)
+        return ts
+
+    def close(self) -> None:
+        if not self._closed:
+            # only latch closed once the marker is durably published — a
+            # failed close() must stay retryable or subscribers hang forever
+            self._mc.publish(
+                _CHAN_NS, self.name, b"", key=_CLOSE_KEY, partition=0
+            )
+            self._closed = True
+
+    def md5(self) -> bytes:
+        return self._md5.digest()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SubChannel:
+    """Read side (chan_sub.go:16): iterates values from the beginning of
+    the channel, ends cleanly at the close marker, and accumulates the
+    same md5 so both ends can compare digests after the stream."""
+
+    def __init__(self, mc: MessagingClient, subscriber_id: str, name: str):
+        self._mc = mc
+        self.subscriber_id = subscriber_id
+        self.name = name
+        self._md5 = hashlib.md5()
+
+    def __iter__(self) -> Iterator[bytes]:
+        for m in self._mc.subscribe(_CHAN_NS, self.name, 0, since_ns=0):
+            if m["key"] == _CLOSE_KEY:
+                return
+            self._md5.update(m["value"])
+            yield m["value"]
+
+    def md5(self) -> bytes:
+        return self._md5.digest()

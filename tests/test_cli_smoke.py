@@ -359,3 +359,44 @@ def test_streaming_upload_bounds_filer_memory(tmp_path):
         assert peak[0] < 280, f"filer RSS peaked at {peak[0]:.0f} MB on GET"
     finally:
         _terminate(filer, volume, master)
+
+
+def test_volume_tail_follows_appends(tmp_path):
+    """volume.tail (volume_tailer.go analog): '+' lines for writes, '-'
+    for deletes, -showTextFile prints bodies, -timeoutSeconds ends the
+    follow loop."""
+    import subprocess
+    import sys
+
+    from seaweedfs_tpu import operation
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp_path)], port=free_port(), master_url=master.url,
+        max_volume_count=4, pulse_seconds=0.5,
+    ).start()
+    try:
+        time.sleep(0.6)
+        a = operation.assign(master.url)
+        operation.upload_data(a.url, a.fid, b"tail me please",
+                              name="t.txt", compress=False)
+        operation.delete_file(master.url, a.fid)
+        vid = int(a.fid.split(",")[0])
+        out = subprocess.run(
+            [sys.executable, "-m", "seaweedfs_tpu", "volume.tail",
+             "-master", master.url, "-volumeId", str(vid),
+             "-rewind", "-1", "-timeoutSeconds", "1", "-showTextFile",
+             "-pollInterval", "0.2"],
+            env=dict(os.environ, PYTHONPATH=REPO),
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        lines = out.stdout.splitlines()
+        assert any(ln.startswith(f"+ {vid},") for ln in lines), out.stdout
+        assert any(ln.startswith(f"- {vid},") for ln in lines), out.stdout
+        assert "tail me please" in out.stdout  # -showTextFile body
+    finally:
+        volume.stop()
+        master.stop()

@@ -224,3 +224,25 @@ def test_publish_after_discard_is_not_acked(stack):
     finally:
         broker.topics.get_partition = orig
     assert status == 410 and "deleted" in resp["error"]
+
+
+def test_pub_sub_channels(stack):
+    """The msgclient channel layer (chan_pub.go/chan_sub.go): values flow
+    pub→sub in order, the close marker ends iteration, and both ends
+    compute the same md5 over the stream."""
+    brokers, _ = stack
+    mc = MessagingClient([b.url for b in brokers])
+    values = [f"payload-{i}".encode() * 3 for i in range(10)]
+    with mc.new_pub_channel("copy42") as pub:
+        for v in values:
+            pub.publish(v)
+    # context exit sent the close marker
+    sub = mc.new_sub_channel("sub-1", "copy42")
+    got = list(sub)
+    assert got == values
+    assert sub.md5() == pub.md5()
+    # publishing after close is refused locally
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        pub.publish(b"late")

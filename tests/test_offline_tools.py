@@ -202,3 +202,48 @@ def test_diff_servers_reports_divergence(tmp_path):
         vs1.stop()
         vs2.stop()
         ms.stop()
+
+
+# -- change.superblock (change_superblock.go analog) --------------------------
+
+
+def test_change_superblock_print_only(tmp_path):
+    vid = _make_volume(tmp_path)
+    out = _run("change.superblock", "-dir", ".", "-volumeId", str(vid),
+               cwd=tmp_path)
+    assert out.returncode == 0, out.stderr
+    assert "Current Volume Replication: 000" in out.stdout
+    assert "Current Volume TTL:" in out.stdout
+    assert "Done." not in out.stdout  # no flags → no write
+
+
+def test_change_superblock_edits_in_place(tmp_path):
+    vid = _make_volume(tmp_path)
+    dat = tmp_path / f"{vid}.dat"
+    before = dat.read_bytes()
+    out = _run("change.superblock", "-dir", ".", "-volumeId", str(vid),
+               "-replication", "001", "-ttl", "3d", cwd=tmp_path)
+    assert out.returncode == 0, out.stderr
+    assert "Changing replication to: 001" in out.stdout
+    assert "Done." in out.stdout
+    after = dat.read_bytes()
+    assert len(after) == len(before)
+    assert after[8:] == before[8:]  # only the superblock header changed
+    # reload through the real volume path and confirm the settings took
+    v = Volume(str(tmp_path), collection="", vid=vid, create_if_missing=False)
+    assert str(v.super_block.replica_placement) == "001"
+    assert str(v.super_block.ttl) == "3d"
+    # needles still readable after the in-place edit
+    n = Needle(cookie=5, id=15)
+    assert v.read_needle(n) > 0
+    assert n.data.startswith(b"needle-15")
+    v.close()
+
+
+def test_change_superblock_roundtrip_print(tmp_path):
+    vid = _make_volume(tmp_path)
+    _run("change.superblock", "-dir", ".", "-volumeId", str(vid),
+         "-replication", "010", cwd=tmp_path)
+    out = _run("change.superblock", "-dir", ".", "-volumeId", str(vid),
+               cwd=tmp_path)
+    assert "Current Volume Replication: 010" in out.stdout
