@@ -76,13 +76,20 @@ def _shard_map(body, mesh, in_specs, out_specs):
     )
 
 
-def make_sharded_encode(mesh, matrix: np.ndarray):
+def make_sharded_encode(mesh, matrix: np.ndarray, process_local: bool = False):
     """Jitted batched encode step over a (dp, sp, tp) mesh.
 
     fn(data: uint8[B, k, N]) → parity uint8[B, m, N], with B sharded over
     'dp', N over 'sp', and the bit-contraction over 'tp' (psum over ICI).
     B % dp == 0, N % (sp * tile) requirements are the caller's to satisfy.
-    """
+
+    With ``process_local=True`` the mesh may span processes
+    (jax.distributed): the caller passes only its process's dp-slice of
+    the batch, inputs are assembled into global arrays with
+    ``make_array_from_process_local_data``, and the returned parity is a
+    global array whose addressable shards are this process's dp rows —
+    the multi-host layout where dp rides DCN and sp/tp ride ICI
+    (docs/SCALING.md)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -129,7 +136,38 @@ def make_sharded_encode(mesh, matrix: np.ndarray):
         out_specs=P("dp", None, "sp"),
     )
 
-    jitted = jax.jit(fn, in_shardings=(NamedSharding(mesh, P("tp", None, None)), data_sharding), out_shardings=out_sharding)
+    bitmat_sharding = NamedSharding(mesh, P("tp", None, None))
+    jitted = jax.jit(
+        fn, in_shardings=(bitmat_sharding, data_sharding),
+        out_shardings=out_sharding,
+    )
+
+    if process_local:
+        # tp/sp axes must live within each process (dp is the only axis
+        # allowed to cross the process boundary — the DCN axis); enforce
+        # it here rather than letting make_array_from_process_local_data
+        # fail with an opaque addressability error downstream
+        for i in range(mesh.devices.shape[0]):
+            procs = {d.process_index for d in mesh.devices[i].flat}
+            if len(procs) != 1:
+                raise ValueError(
+                    "process_local=True requires the sp/tp axes to stay "
+                    f"within one process; dp slice {i} spans processes "
+                    f"{sorted(procs)}"
+                )
+        # every process's local portion of the bit matrix is therefore
+        # the full array; data is dp-sliced
+        bitmat_global = jax.make_array_from_process_local_data(
+            bitmat_sharding, bitmat_stacked
+        )
+
+        def encode_step(local_data):
+            gdata = jax.make_array_from_process_local_data(
+                data_sharding, local_data
+            )
+            return jitted(bitmat_global, gdata)
+
+        return encode_step
 
     def encode_step(data):
         return jitted(bitmat_stacked, data)
