@@ -147,8 +147,9 @@ def make_sharded_encode(mesh, matrix: np.ndarray, process_local: bool = False):
         # allowed to cross the process boundary — the DCN axis); enforce
         # it here rather than letting make_array_from_process_local_data
         # fail with an opaque addressability error downstream
-        for i in range(mesh.devices.shape[0]):
-            procs = {d.process_index for d in mesh.devices[i].flat}
+        dp_axis = mesh.axis_names.index("dp")  # axes addressed by NAME
+        for i, dp_slice in enumerate(np.moveaxis(mesh.devices, dp_axis, 0)):
+            procs = {d.process_index for d in dp_slice.flat}
             if len(procs) != 1:
                 raise ValueError(
                     "process_local=True requires the sp/tp axes to stay "
