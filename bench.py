@@ -432,7 +432,7 @@ def probe_extras() -> None:
     ndir = os.path.dirname(os.path.abspath(spec.origin))
     try:
         subprocess.run(
-            ["make", "-C", ndir, "-s", "-B", "_sweed_native.so"],
+            ["make", "-C", ndir, "-s", "-B", "build/_sweed_native.so"],
             check=True, capture_output=True, timeout=120,
         )
     except Exception as e:  # noqa: BLE001 — record, don't die

@@ -596,14 +596,24 @@ class FuseMount:
         raise RuntimeError("fuse mount did not appear within 10s")
 
     def unmount(self) -> None:
+        # plain unmount first; if the mount is busy, fall back to a lazy
+        # detach — a mountpoint left behind surfaces later as "Transport
+        # endpoint is not connected" when the directory tree is removed
         for cmd in (["fusermount", "-u", self.mountpoint],
-                    ["umount", self.mountpoint]):
+                    ["umount", self.mountpoint],
+                    ["fusermount", "-uz", self.mountpoint],
+                    ["umount", "-l", self.mountpoint]):
             try:
                 r = subprocess.run(cmd, capture_output=True, timeout=10)
                 if r.returncode == 0:
                     break
             except (OSError, subprocess.TimeoutExpired):
                 continue
+        # wait for the detach to land before the caller deletes the tree
+        for _ in range(50):
+            if not os.path.ismount(self.mountpoint):
+                break
+            time.sleep(0.1)
         if self._thread is not None:
             self._thread.join(timeout=5)
         with self._hlock:

@@ -15,7 +15,7 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "sweed_native.cpp")
-_SO = os.path.join(_DIR, "_sweed_native.so")
+_SO = os.path.join(_DIR, "build", "_sweed_native.so")
 
 
 def _ensure_built() -> str:

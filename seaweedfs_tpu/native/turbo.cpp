@@ -1730,7 +1730,11 @@ static void worker_loop(Worker* w) {
   }
   w->conns.clear();
   if (w->listen_fd >= 0) close(w->listen_fd);
-  if (w->stop_fd >= 0) close(w->stop_fd);
+  // stop_fd is NOT closed here: turbo_stop may still be fanning the wake
+  // write out to other workers' stop_fds — closing ours concurrently
+  // races that write (and a recycled fd number would take the 8-byte wake
+  // into an unrelated file). The engine owns stop_fds and closes them
+  // after joining every worker (turbo_stop).
   // a leaked worker keeps notify_fd open: the wedged proxy thread will
   // still write it, and the fd number must not be recycled under it
   if (w->notify_fd >= 0 && !w->leak.load()) close(w->notify_fd);
@@ -1817,6 +1821,7 @@ void turbo_stop(long long handle) {
     (void)!write(fd, &one, 8);
   }
   for (auto& t : e->workers) t.join();
+  for (int fd : e->stop_fds) close(fd);  // workers joined: safe to close
   {
     std::unique_lock<std::shared_mutex> lk(e->reg_mu);
     e->vols.clear();

@@ -24,7 +24,7 @@ from ..util import glog
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "turbo.cpp")
-_SO = os.path.join(_DIR, "_sweed_turbo.so")
+_SO = os.path.join(_DIR, "build", "_sweed_turbo.so")
 
 _lib = None
 _load_failed = False
@@ -40,7 +40,7 @@ def _load():
             and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
         ):
             subprocess.run(
-                ["make", "-C", _DIR, "-s", "_sweed_turbo.so"],
+                ["make", "-C", _DIR, "-s", "build/_sweed_turbo.so"],
                 check=True, capture_output=True, timeout=180,
             )
         lib = ctypes.CDLL(_SO)

@@ -58,3 +58,8 @@ def pytest_configure(config):
         "integration: needs live external daemons "
         "(other/docker-compose.integration.yml); skips cleanly otherwise",
     )
+    config.addinivalue_line(
+        "markers",
+        "soak: full-stack chaos soak (kill-9 + failover under mixed "
+        "traffic); opt-in via SWEED_SOAK=1",
+    )

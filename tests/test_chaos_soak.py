@@ -1,0 +1,383 @@
+"""Full-stack chaos soak (opt-in): mixed S3 + filer + mount traffic while a
+volume server is kill-9'd and restarted AND the master fails over, with
+vacuum and ec.encode running concurrently.
+
+Invariant: every ACKNOWLEDGED write is byte-identical afterward. Writes
+that fail mid-chaos are fine (clients retry); an acked-then-lost or
+acked-then-corrupted write is the one unacceptable outcome.
+
+The stress suite (tests/test_stress_faults.py) exercises these failure
+modes separately; this soak runs them together (VERDICT r4 next #10).
+
+The mount leg's VFS traffic runs in a SUBPROCESS: a process doing kernel
+file I/O against a FUSE mount serviced by its own threads can wedge in
+uninterruptible sleep if chaos stalls the daemon — unkillable and
+undumpable. The FUSE daemon stays in-process; only the kernel-side
+reads/writes are external.
+
+Opt-in:  SWEED_SOAK=1 python -m pytest tests/test_chaos_soak.py -m soak -q
+Duration defaults to ~45s of traffic; SWEED_SOAK_SECONDS overrides.
+"""
+
+import hashlib
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.client import FilerClient
+from seaweedfs_tpu.s3api import IAM, Identity, S3ApiServer
+from seaweedfs_tpu.s3api.s3_client import S3Client
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+pytestmark = [
+    pytest.mark.soak,
+    pytest.mark.skipif(
+        os.environ.get("SWEED_SOAK") != "1",
+        reason="chaos soak is opt-in: set SWEED_SOAK=1",
+    ),
+]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# kernel-side mount writer, run as its own OS process: writes derived
+# payloads, appends "key md5" to the ack log ONLY after close() returned
+MOUNT_WRITER = r"""
+import hashlib, os, sys, time
+mnt, ack_path = sys.argv[1], sys.argv[2]
+i = 0
+with open(ack_path, "a", buffering=1) as ack:
+    while True:
+        i += 1
+        key = f"mnt-{i:05d}"
+        payload = hashlib.sha256(key.encode()).digest() * (17 + i % 640)
+        try:
+            with open(os.path.join(mnt, key), "wb") as f:
+                f.write(payload)
+            ack.write(f"{key} {hashlib.md5(payload).hexdigest()}\n")
+        except Exception:
+            pass
+        time.sleep(0.05)
+"""
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_for(cond, timeout=30.0, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            v = cond()
+        except Exception:
+            v = None
+        if v:
+            return v
+        time.sleep(interval)
+    return None
+
+
+def _spawn_volume_subprocess(vdir, port, master_seeds):
+    """The kill-9 victim must be a real OS process."""
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "volume",
+         "-dir", vdir, "-port", str(port), "-mserver", master_seeds,
+         "-max", "10", "-pulseSeconds", "0.3"],
+        env=dict(os.environ, PYTHONPATH=REPO),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        cwd=REPO,
+    )
+
+
+class Traffic(threading.Thread):
+    """A writer loop: records (key → md5) ONLY for acknowledged writes."""
+
+    def __init__(self, name, write_fn):
+        super().__init__(daemon=True, name=name)
+        self.write_fn = write_fn  # (key, payload) → True if ACKED
+        self.acked: dict[str, str] = {}
+        self.attempts = 0
+        self.stop = threading.Event()
+
+    def run(self):
+        i = 0
+        while not self.stop.is_set():
+            i += 1
+            self.attempts += 1
+            key = f"{self.name}-{i:05d}"
+            payload = hashlib.sha256(key.encode()).digest() * (
+                17 + i % 640
+            )  # 0.5-20 KB, content derived from key
+            try:
+                if self.write_fn(key, payload):
+                    self.acked[key] = hashlib.md5(payload).hexdigest()
+            except Exception:
+                pass  # unacked; the soak keeps going
+            time.sleep(0.01)
+
+
+def test_chaos_soak(tmp_path):
+    import faulthandler
+
+    soak_s = float(os.environ.get("SWEED_SOAK_SECONDS", "45"))
+    # a wedged soak must self-diagnose: dump every thread and die rather
+    # than hang the suite past any useful signal
+    faulthandler.dump_traceback_later(soak_s * 4 + 120, exit=True)
+
+    # THREE masters: the surviving pair must still form a quorum after
+    # the leader is killed (a 2-node cluster cannot elect post-failure)
+    ports = sorted(free_port() for _ in range(3))
+    urls = [f"127.0.0.1:{p}" for p in ports]
+    seeds = ",".join(urls)
+    masters = [
+        MasterServer(port=p, peers=urls, lease_seconds=1.2, node_timeout=5)
+        .start()
+        for p in ports
+    ]
+    vs_stable = victim = filer = s3 = fm = wfs = mount_writer = None
+    try:
+        # stable in-process volume server (vacuum + ec target) ...
+        vs_stable = VolumeServer(
+            [str(tmp_path / "vstable")], port=free_port(), master_url=seeds,
+            max_volume_count=10, pulse_seconds=0.3,
+        ).start()
+        # ... and the kill-9 victim as a subprocess
+        victim_dir = str(tmp_path / "vvictim")
+        os.makedirs(victim_dir)
+        victim_port = free_port()
+        victim = _spawn_volume_subprocess(victim_dir, victim_port, seeds)
+
+        filer = FilerServer(
+            port=free_port(), master_url=seeds, chunk_size=64 * 1024,
+        ).start()
+        s3 = S3ApiServer(
+            port=free_port(), filer_url=filer.url,
+            iam=IAM([Identity("admin", "AK", "SK", ["Admin"])]),
+        ).start()
+        c3 = S3Client(f"http://{s3.url}", "AK", "SK")
+        fc = FilerClient(filer.url)
+
+        def nodes_up():
+            d = http_json("GET", f"http://{urls[0]}/dir/status", timeout=2)
+            racks = d.get("topology", {}).get("data_centers", [{}])[0].get(
+                "racks", [{}]
+            )
+            return len(racks[0].get("nodes", [])) >= 2  # stable + victim
+
+        assert wait_for(nodes_up), "cluster did not form"
+        st, _, _ = c3.create_bucket("soak")
+        assert st == 200
+
+        # -- traffic ---------------------------------------------------------
+        def s3_write(key, payload):
+            st, _, _ = c3.put_object("soak", key, payload)
+            return st == 200
+
+        def filer_write(key, payload):
+            r = fc.put_object(f"/soak-filer/{key}", payload)
+            return bool(r.get("eTag") or r.get("size") == len(payload))
+
+        workers = [Traffic("s3", s3_write), Traffic("filer", filer_write)]
+
+        # optional mount leg (environment may refuse FUSE); the FUSE daemon
+        # lives here, the kernel-side writer is a subprocess
+        mount_dir = str(tmp_path / "mnt")
+        mount_ack = str(tmp_path / "mnt-acked.log")
+        try:
+            from seaweedfs_tpu.mount.fuse_mount import FuseMount
+            from seaweedfs_tpu.mount.wfs import WFS
+
+            wfs = WFS(filer.url)
+            fm = FuseMount(wfs, mount_dir).mount()
+        except Exception:
+            fm = None  # soak still meaningful without the kernel leg
+        if fm is not None:
+            mount_writer = subprocess.Popen(
+                [sys.executable, "-c", MOUNT_WRITER, mount_dir, mount_ack],
+                env=dict(os.environ, PYTHONPATH=REPO),
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+
+        for w in workers:
+            w.start()
+
+        # -- concurrent maintenance: vacuum + ec.encode loop -----------------
+        maint_stop = threading.Event()
+        maint_errors: list[str] = []
+        encoded: set[int] = set()
+
+        def maintenance():
+            while not maint_stop.is_set():
+                try:
+                    http_json(
+                        "POST", f"http://{urls[0]}/vol/vacuum", timeout=5
+                    )
+                except Exception:
+                    try:  # leader may have moved
+                        http_json(
+                            "POST", f"http://{urls[1]}/vol/vacuum", timeout=5
+                        )
+                    except Exception:
+                        pass
+                try:
+                    # seal ONE not-yet-encoded volume per cycle — ec.encode
+                    # of a volume traffic just wrote to (marks it readonly
+                    # mid-soak) is exactly the concurrent-maintenance chaos
+                    # the soak wants
+                    vols = http_json(
+                        "GET",
+                        f"http://{vs_stable.host}:{vs_stable.port}/status",
+                        timeout=5,
+                    ).get("volumes", [])
+                    for v in vols:
+                        if v["id"] not in encoded:
+                            http_json(
+                                "POST",
+                                f"http://{vs_stable.host}:{vs_stable.port}"
+                                f"/admin/ec/generate?volume={v['id']}",
+                                timeout=60,
+                            )
+                            # only a SUCCESSFUL generate retires the volume
+                            # from the rotation — a transient failure must
+                            # be retried, not silently skipped forever
+                            encoded.add(v["id"])
+                            break
+                except Exception as e:  # noqa: BLE001
+                    maint_errors.append(str(e)[:120])
+                maint_stop.wait(5)
+
+        mt = threading.Thread(target=maintenance, daemon=True)
+        mt.start()
+
+        # -- chaos timeline --------------------------------------------------
+        t0 = time.time()
+        time.sleep(soak_s * 0.25)
+        victim.send_signal(signal.SIGKILL)  # kill -9 mid-traffic
+        victim.wait()
+        time.sleep(soak_s * 0.2)
+        victim = _spawn_volume_subprocess(victim_dir, victim_port, seeds)
+
+        time.sleep(soak_s * 0.2)
+        masters[0].stop()  # leader dies; follower must take over
+
+        def new_leader():
+            for u in urls[1:]:
+                lead = http_json(
+                    "GET", f"http://{u}/cluster/status", timeout=2
+                ).get("leader")
+                if lead and lead != urls[0]:
+                    return lead
+            return None
+
+        assert wait_for(new_leader, timeout=30), "failover did not converge"
+
+        while time.time() - t0 < soak_s:
+            time.sleep(0.5)
+
+        for w in workers:
+            w.stop.set()
+        for w in workers:
+            w.join(timeout=30)
+        maint_stop.set()
+        mt.join(timeout=30)
+        if mount_writer is not None:
+            mount_writer.send_signal(signal.SIGKILL)
+            mount_writer.wait(timeout=10)
+            mount_writer = None
+
+        # settle: surviving master + both volume servers heartbeating
+        time.sleep(2.0)
+
+        # -- the invariant: every acked write reads back byte-identical ------
+        lost: list[str] = []
+        for w in workers:
+            assert w.acked, f"{w.name}: no writes were ever acked"
+            # snapshot: a worker whose last write outlived the join timeout
+            # may still insert one final ack mid-iteration
+            for key, md5 in list(w.acked.items()):
+                try:
+                    if w.name == "s3":
+                        st, data, _ = c3.get_object("soak", key)
+                    else:
+                        st, data, _ = fc.get_object(f"/soak-filer/{key}")
+                    ok = st == 200 and hashlib.md5(data).hexdigest() == md5
+                except Exception:
+                    ok = False
+                if not ok:
+                    lost.append(f"{w.name}:{key}")
+        summary = {
+            w.name: f"{len(w.acked)}/{w.attempts} acked" for w in workers
+        }
+
+        mnt_acked = {}
+        if fm is not None and os.path.exists(mount_ack):
+            for line in open(mount_ack):
+                key, _, md5 = line.strip().partition(" ")
+                if md5:
+                    mnt_acked[key] = md5
+            summary["mnt"] = f"{len(mnt_acked)} acked"
+            # verify through the kernel from a bounded subprocess — never
+            # VFS-touch our own mount from the test process
+            if mnt_acked:
+                keys = sorted(mnt_acked)
+                r = subprocess.run(
+                    ["md5sum"] + [os.path.join(mount_dir, k) for k in keys],
+                    capture_output=True, text=True, timeout=120,
+                )
+                got = {
+                    os.path.basename(parts[1]): parts[0]
+                    for parts in (
+                        ln.split() for ln in r.stdout.splitlines()
+                    )
+                    if len(parts) == 2
+                }
+                for key, md5 in mnt_acked.items():
+                    if got.get(key) != md5:
+                        lost.append(f"mnt:{key}")
+
+        assert not lost, (
+            f"acked writes lost/corrupted: {lost[:20]} ({summary})"
+        )
+        # the concurrent-maintenance leg must have actually run: the soak
+        # claims ec.encode happened during chaos, so at least one volume
+        # must have been sealed (the stable server stays up throughout)
+        assert encoded, "no ec.encode ever succeeded during the soak"
+        print(
+            f"soak ok: {summary}, ec_encoded={sorted(encoded)}, "
+            f"maint_errors={len(maint_errors)}"
+        )
+    finally:
+        faulthandler.cancel_dump_traceback_later()
+        if mount_writer is not None:
+            mount_writer.kill()
+        if fm is not None:
+            fm.unmount()
+        if wfs is not None:
+            wfs.close()
+        if s3 is not None:
+            s3.stop()
+        if filer is not None:
+            filer.stop()
+        if victim is not None and victim.poll() is None:
+            victim.kill()
+            victim.wait(timeout=10)
+        if vs_stable is not None:
+            vs_stable.stop()
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass

@@ -48,7 +48,7 @@ def test_turbo_engine_race_free_under_tsan(tmp_path):
     tsan_opts = (os.environ.get("TSAN_OPTIONS", "") +
                  " halt_on_error=0 history_size=7").strip()
     run = subprocess.run(
-        [os.path.join(NATIVE, "tsan_harness"), str(tmp_path)],
+        [os.path.join(NATIVE, "build", "tsan_harness"), str(tmp_path)],
         capture_output=True, text=True, timeout=120,
         env=dict(os.environ, TSAN_OPTIONS=tsan_opts),
     )
