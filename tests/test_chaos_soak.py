@@ -244,16 +244,19 @@ def test_chaos_soak(tmp_path):
                     ).get("volumes", [])
                     for v in vols:
                         if v["id"] not in encoded:
-                            http_json(
+                            r = http_json(
                                 "POST",
                                 f"http://{vs_stable.host}:{vs_stable.port}"
                                 f"/admin/ec/generate?volume={v['id']}",
                                 timeout=60,
                             )
                             # only a SUCCESSFUL generate retires the volume
-                            # from the rotation — a transient failure must
-                            # be retried, not silently skipped forever
-                            encoded.add(v["id"])
+                            # from the rotation — http_json returns error
+                            # DICTS for HTTP>=400, so check, don't assume
+                            if r.get("shards") and not r.get("error"):
+                                encoded.add(v["id"])
+                            else:
+                                maint_errors.append(str(r)[:120])
                             break
                 except Exception as e:  # noqa: BLE001
                     maint_errors.append(str(e)[:120])
@@ -271,14 +274,24 @@ def test_chaos_soak(tmp_path):
         victim = _spawn_volume_subprocess(victim_dir, victim_port, seeds)
 
         time.sleep(soak_s * 0.2)
-        masters[0].stop()  # leader dies; follower must take over
+        # kill the ACTUAL leader (election is vote-based, any master can
+        # win) — stopping a follower would test nothing
+        leader_url = wait_for(
+            lambda: http_json(
+                "GET", f"http://{urls[0]}/cluster/status", timeout=2
+            ).get("leader"),
+            timeout=20,
+        )
+        assert leader_url in urls, f"no leader to kill: {leader_url}"
+        masters[urls.index(leader_url)].stop()
+        survivors = [u for u in urls if u != leader_url]
 
         def new_leader():
-            for u in urls[1:]:
+            for u in survivors:
                 lead = http_json(
                     "GET", f"http://{u}/cluster/status", timeout=2
                 ).get("leader")
-                if lead and lead != urls[0]:
+                if lead and lead != leader_url:
                     return lead
             return None
 
