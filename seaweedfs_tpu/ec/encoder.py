@@ -242,11 +242,12 @@ def write_ec_files(
     """Generate all shard files from ``base.dat`` (WriteEcFiles, :57).
 
     Device-backed codecs (TpuCodec, MeshCodec — anything with
-    ``matmul_device``) run a 3-stage overlap pipeline: a reader thread
+    ``matmul_device``) run a 4-leg overlap pipeline: a reader thread
     streams column chunks off disk, the main thread stages them into HBM and
-    dispatches the (async) encode kernel, and a writer thread blocks on each
-    chunk's parity and appends the 14 shard files. Disk read, H2D copy,
-    compute and file writes for neighbouring chunks overlap — the reference's
+    dispatches the (async) encode kernel, a fetch thread blocks on each
+    chunk's parity (the D2H leg), and a writer thread appends the 14 shard
+    files. Disk read, H2D copy, compute, D2H and file writes for
+    neighbouring chunks overlap — the reference's
     serial 256KB read→Encode→write loop (`ec_encoder.go:162-192`) turned into
     a pipeline sized for a TPU. Host-only codecs keep the serial loop.
     """
