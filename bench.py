@@ -359,16 +359,11 @@ def probe_e2e(dat_mb: int, sink: str = "disk") -> None:
         rng = np.random.default_rng(0)
         with open(base + ".dat", "wb") as f:
             f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
-        # the same work plan write_ec_files will compute internally
+        # the same work plan write_ec_files will compute internally —
+        # shared planner, so the warm list below cannot drift from the
+        # timed run's actual item widths
         k = codec.data_shards
-        chunk = encoder._budgeted_chunk(codec, codec.chunk_bytes,
-                                        codec.total_shards)
-        if chunk >= encoder.SMALL_BLOCK_SIZE:
-            chunk = encoder._depth_chunk(chunk, -(-n // k),
-                                         encoder.SMALL_BLOCK_SIZE)
-        items = encoder._work_items(
-            n, k, encoder.LARGE_BLOCK_SIZE, encoder.SMALL_BLOCK_SIZE, chunk
-        )
+        chunk, items = encoder.plan_encode(codec, n)
         # warm every kernel shape the timed run will launch: Mosaic
         # compiles per column width, and one compile inside the timed
         # region would swamp the measurement
@@ -388,10 +383,10 @@ def probe_e2e(dat_mb: int, sink: str = "disk") -> None:
                 base + ".dat", items, codec, outputs, n, stats=stats
             )
         else:
-            # same precomputed chunk the warm loop used — the timed run must
-            # launch only warmed kernel shapes
+            # the exact plan the warm loop used — the timed run must launch
+            # only warmed kernel shapes, so no internal re-derivation
             encoder.write_ec_files(
-                base, codec, chunk_bytes=chunk, pipeline_stats=stats
+                base, codec, plan=(chunk, items), pipeline_stats=stats
             )
         dt = time.perf_counter() - t0
         log(
@@ -626,8 +621,8 @@ def main() -> None:
     # (32,128) measured up to ~77-88 GB/s in r5 probes (tile sweep beyond
     # 32KB was never tried before); kept second so the best-of-2 early
     # stop compares it against the long-standing (32,16)
-    for chunk_mb, tile_kb in ((32, 16), (32, 128), (32, 32), (16, 16),
-                              (8, 16)):
+    for chunk_mb, tile_kb in ((32, 16), (32, 128), (32, 64), (32, 32),
+                              (16, 16), (8, 16)):
         try:
             r = _run_probe(["--probe", str(chunk_mb), str(tile_kb)])
             if r.returncode == 0 and r.stdout.strip():

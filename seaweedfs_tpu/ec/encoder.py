@@ -231,6 +231,38 @@ def _budgeted_chunk(codec, chunk: int, device_streams: int) -> int:
     return min(chunk, cap)
 
 
+def plan_encode(
+    codec,
+    dat_size: int,
+    large_block_size: int = LARGE_BLOCK_SIZE,
+    small_block_size: int = SMALL_BLOCK_SIZE,
+    chunk_bytes: Optional[int] = None,
+) -> tuple[int, list]:
+    """The encode work plan — one source of truth for write_ec_files AND
+    for callers that must know the plan up front (bench.py warms every
+    Mosaic kernel shape the timed run will launch; a drifted re-derivation
+    would compile inside the timed region and skew the published rate).
+
+    Returns ``(chunk, items)``. An explicit ``chunk_bytes`` fixes the
+    pipeline depth (no _depth_chunk re-split) but is still capped against
+    free HBM — the caller owns the plan's shape, not its memory safety
+    (rebuild_ec_files applies the same cap to explicit chunks)."""
+    k = codec.data_shards
+    chunk = (
+        chunk_bytes if chunk_bytes is not None
+        else getattr(codec, "chunk_bytes", 8 * 1024 * 1024)
+    )
+    chunk = _budgeted_chunk(codec, chunk, k + codec.parity_shards)
+    if (
+        chunk_bytes is None
+        and hasattr(codec, "matmul_device")
+        and chunk >= small_block_size
+    ):
+        chunk = _depth_chunk(chunk, -(-dat_size // k), small_block_size)
+    items = _work_items(dat_size, k, large_block_size, small_block_size, chunk)
+    return chunk, items
+
+
 def write_ec_files(
     base_file_name: str,
     codec: Optional[Codec] = None,
@@ -238,8 +270,16 @@ def write_ec_files(
     small_block_size: int = SMALL_BLOCK_SIZE,
     chunk_bytes: Optional[int] = None,
     pipeline_stats: Optional[dict] = None,
+    plan: Optional[tuple] = None,
 ) -> None:
     """Generate all shard files from ``base.dat`` (WriteEcFiles, :57).
+
+    ``plan`` — a ``(chunk, items)`` pair from :func:`plan_encode` for the
+    same volume. Callers that pre-warmed kernel shapes against a plan
+    (bench.py) pass it here verbatim; re-deriving internally could read a
+    different free-HBM figure and split chunks the warm loop never saw,
+    compiling inside the timed region. Without ``plan``, the plan is
+    derived here (and an explicit ``chunk_bytes`` is still budget-capped).
 
     Device-backed codecs (TpuCodec, MeshCodec — anything with
     ``matmul_device``) run a 4-leg overlap pipeline: a reader thread
@@ -255,17 +295,9 @@ def write_ec_files(
     k, m = codec.data_shards, codec.parity_shards
     dat = base_file_name + ".dat"
     dat_size = os.path.getsize(dat)
-    if chunk_bytes is not None:
-        # explicit chunk: the caller owns the plan (bench warms kernel
-        # shapes against a precomputed item list — re-deriving here could
-        # drift if device_memory_free moved between the two readings)
-        chunk = chunk_bytes
-    else:
-        chunk = getattr(codec, "chunk_bytes", 8 * 1024 * 1024)
-        chunk = _budgeted_chunk(codec, chunk, k + m)
-        if hasattr(codec, "matmul_device") and chunk >= small_block_size:
-            chunk = _depth_chunk(chunk, -(-dat_size // k), small_block_size)
-    items = _work_items(dat_size, k, large_block_size, small_block_size, chunk)
+    _, items = plan or plan_encode(
+        codec, dat_size, large_block_size, small_block_size, chunk_bytes
+    )
 
     outputs = [open(base_file_name + shard_ext(i), "wb") for i in range(k + m)]
     try:
@@ -485,7 +517,10 @@ def rebuild_ec_files(
     (RebuildEcFiles / generateMissingEcFiles, :61,95). Returns generated ids."""
     codec = codec or get_codec()
     total = codec.total_shards
-    chunk = chunk_bytes or getattr(codec, "chunk_bytes", 8 * 1024 * 1024)
+    chunk = (
+        chunk_bytes if chunk_bytes is not None
+        else getattr(codec, "chunk_bytes", 8 * 1024 * 1024)
+    )
     chunk = _budgeted_chunk(codec, chunk, total)
 
     present: dict[int, str] = {}

@@ -13,6 +13,15 @@ from typing import Iterator, Optional
 
 from ..server.http_util import http_bytes, http_json
 from .consistent import ConsistentRing
+from .consistent import _hash as _ring_hash
+
+def partition_for_key(key: bytes, partitions: int) -> int:
+    """Stable key→partition routing, NOT Python hash(): per-key ordering
+    only holds if every producer process (and every restart — hash(bytes)
+    is salted per-interpreter via PYTHONHASHSEED) routes the same key to
+    the same partition. Shares the ring's digest (consistent._hash)."""
+    return _ring_hash(key) % partitions
+
 
 # the reference marks end-of-channel with Message.IsClose (chan_pub.go:55);
 # this wire carries key+value, so a reserved key is the close marker — keys
@@ -68,7 +77,10 @@ class MessagingClient:
         if partition is None:
             conf = self.topic_conf(ns, topic)
             n = conf.get("partitions", 1)
-            partition = (hash(key) if key else time.monotonic_ns()) % n
+            partition = (
+                partition_for_key(key, n) if key
+                else time.monotonic_ns() % n
+            )
         broker = self._broker_for(ns, topic, partition)
         import urllib.request
 

@@ -8,8 +8,10 @@ import bisect
 import hashlib
 
 
-def _hash(key: str) -> int:
-    return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+def _hash(key: "str | bytes") -> int:
+    if isinstance(key, str):
+        key = key.encode()
+    return int.from_bytes(hashlib.md5(key).digest()[:8], "big")
 
 
 class ConsistentRing:

@@ -203,6 +203,35 @@ def test_budgeted_chunk_caps_against_free_hbm():
     assert _budgeted_chunk(NoStats(), 8 << 20, 14) == 8 << 20
 
 
+def test_plan_encode_caps_explicit_chunk():
+    """An explicit chunk_bytes fixes pipeline depth but must NOT bypass the
+    HBM budget — a caller asking for 32MB on a starved chip gets the capped
+    plan, not RESOURCE_EXHAUSTED (same contract as rebuild_ec_files)."""
+    from seaweedfs_tpu.ec.encoder import plan_encode
+
+    class Starved:
+        data_shards, parity_shards = 10, 4
+
+        def device_memory_free(self):
+            return 256 << 20
+
+        def alignment(self):
+            return 65536
+
+        def matmul_device(self, *a):  # marks this as a device codec
+            raise NotImplementedError
+
+    chunk, items = plan_encode(Starved(), 1 << 20, chunk_bytes=32 << 20)
+    assert chunk < 32 << 20 and chunk % 65536 == 0
+    assert items
+    # and without stats the explicit request is honored verbatim
+    class Cpu:
+        data_shards, parity_shards = 10, 4
+
+    chunk, _ = plan_encode(Cpu(), 1 << 20, chunk_bytes=32 << 20)
+    assert chunk == 32 << 20
+
+
 def test_native_kernel_reports_variant():
     """The native lib self-reports which rs_matmul inner loop compiled in,
     so bench artifacts can distinguish a stale/slow build from a host

@@ -1,5 +1,6 @@
 """Message broker: log buffer, consistent ring, pub/sub over a live stack."""
 
+import os
 import socket
 import time
 
@@ -105,6 +106,34 @@ def test_pub_sub_roundtrip(stack):
         msgs, _ = mc.fetch("chat", "room1", p)
         got.extend(m["value"].decode() for m in msgs)
     assert got == [f"msg-{i}" for i in range(20)]
+
+
+def test_keyed_partition_is_process_stable():
+    """Key→partition must be a stable digest, not Python's salted hash():
+    two producer processes (different PYTHONHASHSEED) must route the same
+    key to the same partition or per-key ordering breaks."""
+    import subprocess
+    import sys
+
+    from seaweedfs_tpu.messaging.client import partition_for_key
+
+    expect = partition_for_key(b"user-1", 4)
+    code = (
+        "from seaweedfs_tpu.messaging.client import partition_for_key;"
+        "print(partition_for_key(b'user-1', 4))"
+    )
+    for seed in ("0", "12345"):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={
+                "PYTHONHASHSEED": seed,
+                "PATH": os.environ.get("PATH", ""),
+                "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+            },
+            capture_output=True, text=True, timeout=30,
+        )
+        assert out.returncode == 0, out.stderr
+        assert int(out.stdout.strip()) == expect
 
 
 def test_replay_from_persisted_segments(stack):
