@@ -41,6 +41,10 @@ ERR_INVALID_ACCESS_KEY = "InvalidAccessKeyId"
 ERR_SIGNATURE_MISMATCH = "SignatureDoesNotMatch"
 ERR_MISSING_FIELDS = "MissingFields"
 ERR_EXPIRED_REQUEST = "ExpiredPresignRequest"
+# the reference's ErrRequestNotReadyYet serializes as code "AccessDenied"
+# with 403 (s3api_errors.go:317-321) — a URL dated in the future is not
+# "expired", it has not begun its validity window
+ERR_REQUEST_NOT_READY = "AccessDenied"
 
 
 @dataclass
@@ -246,6 +250,11 @@ class IAM:
             return None, ERR_MISSING_FIELDS
         if _time.time() > signed_at.timestamp() + expires:
             return None, ERR_EXPIRED_REQUEST
+        # a URL "signed" in the future defeats X-Amz-Expires (it would stay
+        # valid for future+expires); the reference allows only 15 minutes of
+        # clock skew ahead (auth_signature_v4.go:361-364)
+        if signed_at.timestamp() > _time.time() + 15 * 60:
+            return None, ERR_REQUEST_NOT_READY
         sig = self._v4_signature(
             ident.secret_key,
             method,
