@@ -384,12 +384,16 @@ def decode_aws_chunked(
         header = body[pos:nl].decode()
         size_str, _, sig_part = header.partition(";")
         size = int(size_str, 16)
+        if size < 0:
+            raise ValueError(f"negative chunk size {size_str!r}")
         pos = nl + 2
         data = body[pos : pos + size]
         if verify is not None:
             given = sig_part.partition("=")[2]
             want = verify.next_chunk_signature(data)
-            if not hmac.compare_digest(given, want):
+            # compare as bytes: compare_digest raises TypeError on non-ASCII
+            # str input, which would turn a garbage signature into a 500
+            if not hmac.compare_digest(given.encode(), want.encode()):
                 raise ChunkSignatureError(f"chunk at {pos} signature mismatch")
         if size == 0:
             break

@@ -43,6 +43,7 @@ _ERR_STATUS = {
     "ExpiredPresignRequest": 403,
     "MissingFields": 400,
     "MalformedXML": 400,
+    "IncompleteBody": 400,
     "InvalidPart": 400,
     "BucketAlreadyExists": 409,
     "BucketNotEmpty": 409,
@@ -241,13 +242,9 @@ class S3ApiServer:
         src = headers.get("X-Amz-Copy-Source", "")
         if src:
             return self._copy_object(bucket, key, src)
-        if headers.get("X-Amz-Content-Sha256") == s3auth.STREAMING_PAYLOAD:
-            try:
-                body = s3auth.decode_aws_chunked(
-                    body, verify=self.iam.streaming_context(headers)
-                )
-            except s3auth.ChunkSignatureError:
-                return _err("SignatureDoesNotMatch", key)
+        body, chunk_err = self._decode_chunked(headers, body, key)
+        if chunk_err is not None:
+            return chunk_err
         extended = {
             k.title(): v
             for k, v in headers.items()
@@ -268,6 +265,23 @@ class S3ApiServer:
                 extended=extended,
             )
         return 200, b"", {"ETag": f'"{r.get("eTag", "")}"'}
+
+    def _decode_chunked(self, headers, body, key):
+        """Undo STREAMING-AWS4-HMAC-SHA256-PAYLOAD framing, verifying the
+        per-chunk signature chain. Returns (body, None) — body unchanged
+        when the request wasn't aws-chunked — or (None, error_response)."""
+        if headers.get("X-Amz-Content-Sha256") != s3auth.STREAMING_PAYLOAD:
+            return body, None
+        try:
+            return s3auth.decode_aws_chunked(
+                body, verify=self.iam.streaming_context(headers)
+            ), None
+        except s3auth.ChunkSignatureError:
+            return None, _err("SignatureDoesNotMatch", key)
+        except ValueError:
+            # malformed chunk framing (bad hex size / missing CRLF) must be
+            # the client's 400, not an unhandled 500
+            return None, _err("IncompleteBody", key)
 
     def _copy_object(self, bucket, key, src):
         sb, sk = _parse_copy_source(src)
@@ -479,13 +493,9 @@ class S3ApiServer:
                 headers["X-Amz-Copy-Source"],
                 headers.get("X-Amz-Copy-Source-Range", ""),
             )
-        if headers.get("X-Amz-Content-Sha256") == s3auth.STREAMING_PAYLOAD:
-            try:
-                body = s3auth.decode_aws_chunked(
-                    body, verify=self.iam.streaming_context(headers)
-                )
-            except s3auth.ChunkSignatureError:
-                return _err("SignatureDoesNotMatch", key)
+        body, chunk_err = self._decode_chunked(headers, body, key)
+        if chunk_err is not None:
+            return chunk_err
         r = self.client.put_object(
             f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part", body
         )

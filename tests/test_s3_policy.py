@@ -511,4 +511,3 @@ def test_anonymous_post_via_bucket_policy_allow(s3, admin):
     status, _ = anon_request(f"http://{s3.url}/dropbox/anon.bin")
     assert status == 403
     admin.request("DELETE", "/dropbox", query={"policy": ""})
-

@@ -448,6 +448,36 @@ def test_aws_chunked_upload(s3, client):
     assert status == 404
 
 
+def test_aws_chunked_malformed_framing_is_client_error(s3, client):
+    """Garbage aws-chunked framing (bad hex size, negative size, missing
+    CRLF) must come back 400 IncompleteBody — an unhandled parse exception
+    would surface as the gateway's 500."""
+    client.create_bucket("chunkbad")
+    for body in (
+        b"ZZZ;chunk-signature=00\r\nqq\r\n",        # non-hex size
+        b"-5;chunk-signature=00\r\nqq\r\n",         # negative size
+        b"3e8;chunk-signature=00",                  # truncated, no CRLF
+    ):
+        status, resp, _ = client.put_object(
+            "chunkbad",
+            "bad.bin",
+            body,
+            **{"X-Amz-Content-Sha256": "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"},
+        )
+        assert status == 400 and b"IncompleteBody" in resp, (status, body)
+    # a non-ASCII "signature" must be a 403 mismatch, not a TypeError-500
+    # from comparing non-ASCII strings inside compare_digest
+    status, resp, _ = client.put_object(
+        "chunkbad",
+        "bad.bin",
+        b"2;chunk-signature=\xc3\xa9\r\nqq\r\n0;chunk-signature=00\r\n\r\n",
+        **{"X-Amz-Content-Sha256": "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"},
+    )
+    assert status == 403 and b"SignatureDoesNotMatch" in resp, status
+    status, _, _ = client.get_object("chunkbad", "bad.bin")
+    assert status == 404
+
+
 def test_delete_implicit_directory_is_noop(client):
     """DELETE of a key that is only an implicit directory must not wipe the
     prefix (S3 semantics: the named object doesn't exist → 204, no effect)."""
