@@ -45,6 +45,7 @@ _ERR_STATUS = {
     "MalformedXML": 400,
     "IncompleteBody": 400,
     "InvalidPart": 400,
+    "InvalidArgument": 400,
     "BucketAlreadyExists": 409,
     "BucketNotEmpty": 409,
     "NoSuchBucketPolicy": 404,
@@ -165,14 +166,28 @@ class S3ApiServer:
             return _err("NoSuchBucket", bucket)
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
-        max_keys = int(q.get("max-keys", 1000))
+        try:
+            max_keys = int(q.get("max-keys", 1000))
+        except ValueError:
+            return _err("InvalidArgument", bucket,
+                        "max-keys must be an integer")
+        if max_keys < 0:
+            return _err("InvalidArgument", bucket,
+                        "max-keys must be non-negative")
         if v2:
             marker = q.get("continuation-token", "") or q.get("start-after", "")
         else:
             marker = q.get("marker", "")
         contents, common = [], []
         truncated = False
-        for key, e in self._iter_keys(self._bucket_dir(bucket), "", prefix, marker):
+        keys_iter = (
+            self._iter_keys(self._bucket_dir(bucket), "", prefix, marker)
+            if max_keys > 0 else ()
+            # max-keys=0 is an empty NON-truncated listing (AWS semantics);
+            # entering the loop would emit IsTruncated=true with an empty
+            # continuation token and trap v2 paginators in a loop
+        )
+        for key, e in keys_iter:
             if prefix and not key.startswith(prefix):
                 continue
             if marker and key <= marker:
@@ -480,7 +495,16 @@ class S3ApiServer:
 
     def _upload_part(self, bucket, key, q, body, headers):
         upload_id = q["uploadId"]
-        part = int(q["partNumber"])
+        try:
+            part = int(q["partNumber"])
+        except (KeyError, ValueError):
+            return _err("InvalidArgument", key,
+                        "partNumber must be an integer")
+        if not 1 <= part <= 10000:
+            # AWS bounds (the completed-upload concatenation sorts by part
+            # number, and the part file name is a 4-digit field)
+            return _err("InvalidArgument", key,
+                        "partNumber must be between 1 and 10000")
         if self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/.info") is None:
             return _err("NoSuchUpload", upload_id)
         if headers.get("X-Amz-Copy-Source"):
@@ -497,7 +521,7 @@ class S3ApiServer:
         if chunk_err is not None:
             return chunk_err
         r = self.client.put_object(
-            f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part", body
+            f"{UPLOADS_DIR}/{upload_id}/{part:05d}.part", body
         )
         return 200, b"", {"ETag": f'"{r.get("eTag", "")}"'}
 
@@ -533,7 +557,7 @@ class S3ApiServer:
         length = int(clen)
         try:
             r = self.client.put_object_stream(
-                f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part", resp, length
+                f"{UPLOADS_DIR}/{upload_id}/{part:05d}.part", resp, length
             )
         finally:
             resp.close()
@@ -561,7 +585,7 @@ class S3ApiServer:
             return _err("MalformedXML", key)
         chunks, md5_digests, offset = [], [], 0
         for part in sorted(part_numbers):
-            pe = self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part")
+            pe = self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/{part:05d}.part")
             if pe is None:
                 return _err("InvalidPart", str(part))
             md5_digests.append(bytes.fromhex(pe.get("extended", {}).get("md5", "")))
@@ -584,7 +608,7 @@ class S3ApiServer:
         )
         # parts not referenced by the Complete request would otherwise leak
         # their chunks — purge them explicitly first
-        wanted = {f"{p:04d}.part" for p in part_numbers}
+        wanted = {f"{p:05d}.part" for p in part_numbers}
         for e in self.client.list(f"{UPLOADS_DIR}/{upload_id}", limit=10001):
             if e["name"].endswith(".part") and e["name"] not in wanted:
                 self.client.delete(f"{UPLOADS_DIR}/{upload_id}/{e['name']}")

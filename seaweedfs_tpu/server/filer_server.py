@@ -197,6 +197,16 @@ class FilerServer:
         t.start()
 
     # -- meta subscribe / kv / status (filer_pb rpc analogs) -----------------
+    @staticmethod
+    def _qint(q, key, default):
+        """Tolerant query-int: garbage falls back to the default, the way
+        the reference's handlers treat strconv.Atoi failures — a client's
+        bad parameter must not surface as the daemon's 500."""
+        try:
+            return int(q.get(key, default))
+        except ValueError:
+            return default
+
     def _h_assign(self, h, path, q, body):
         """AssignVolume rpc analog (pb/filer.proto): mount and other write-
         through clients get fids + upload urls without talking to the
@@ -204,7 +214,7 @@ class FilerServer:
         try:
             a = operation.assign(
                 self.master_url,
-                count=int(q.get("count", 1)),
+                count=self._qint(q, "count", 1),
                 collection=q.get("collection", self.collection),
                 replication=q.get("replication", self.replication),
                 ttl=q.get("ttl", ""),
@@ -220,9 +230,14 @@ class FilerServer:
         }
 
     def _meta_reply(self, log, q):
-        since = int(q.get("since_ns", 0))
-        limit = int(q.get("limit", 1000))
-        wait_s = min(float(q.get("wait_s", 0)), 30.0)
+        since = self._qint(q, "since_ns", 0)
+        limit = self._qint(q, "limit", 1000)
+        try:
+            wait_s = min(float(q.get("wait_s", 0)), 30.0)
+        except ValueError:
+            wait_s = 0.0
+        if not wait_s > 0:  # catches negatives AND NaN (nan > 0 is False);
+            wait_s = 0.0    # a NaN deadline busy-loops Condition.wait
         events = log.wait_since(since, timeout=wait_s)[:limit]
         out = [e.to_dict() for e in events]
         last = out[-1]["ts_ns"] if out else since
@@ -512,7 +527,7 @@ class FilerServer:
         ):
             return 200, entry.to_dict()
         if entry.is_directory:
-            limit = int(q.get("limit", 1000))
+            limit = self._qint(q, "limit", 1000)
             prefix = q.get("prefix", "")
             full_meta = q.get("meta") == "true"
             entries = []

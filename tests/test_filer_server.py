@@ -177,3 +177,22 @@ def test_http_surface_fuzz_burst(cluster):
     assert st == 201
     st, data = http_bytes("GET", f"http://{filer.url}/fz/ok.txt")
     assert (st, data) == (200, b"alive")
+
+
+def test_meta_watch_garbage_params_return_promptly(cluster):
+    """wait_s=nan must not busy-spin the handler thread (NaN poisons the
+    Condition.wait deadline arithmetic); garbage since_ns/limit fall back
+    to defaults instead of 500."""
+    import time as _t
+
+    from seaweedfs_tpu.server.http_util import http_bytes
+
+    _, _, filer = cluster
+    for qs in ("wait_s=nan", "wait_s=-5", "since_ns=zz&limit=yy&wait_s=zz"):
+        t0 = _t.perf_counter()
+        st, _ = http_bytes("GET", f"http://{filer.url}/_meta/events?{qs}")
+        dt = _t.perf_counter() - t0
+        assert st == 200, (qs, st)
+        # all three fall back to wait_s=0 (nan/negative/unparseable): the
+        # reply must be immediate, not a spin and not the 30s long-poll cap
+        assert dt < 5.0, (qs, dt)

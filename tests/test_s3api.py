@@ -196,6 +196,55 @@ def test_multipart_upload(client):
     assert status == 200 and data == b"".join(parts)
 
 
+def test_multipart_part_number_bounds_and_ordering(client):
+    """partNumber outside 1..10000 (or non-integer) is 400 InvalidArgument;
+    part 10000 — AWS's maximum — must work and list in ascending order
+    (the part files are named {part:05d}.part so name order == numeric)."""
+    client.create_bucket("mpb")
+    status, body, _ = client.request("POST", "/mpb/x", query={"uploads": ""})
+    upload_id = find_text(parse_xml(body), "UploadId")
+    for bad in ("0", "10001", "zz", "-1", ""):
+        status, body, _ = client.request(
+            "PUT", "/mpb/x",
+            query={"partNumber": bad, "uploadId": upload_id}, body=b"d",
+        )
+        assert status == 400 and b"InvalidArgument" in body, (bad, status)
+    # missing partNumber entirely
+    status, body, _ = client.request(
+        "PUT", "/mpb/x", query={"uploadId": upload_id}, body=b"d"
+    )
+    assert status == 400 and b"InvalidArgument" in body
+    for num in (10000, 2):  # upload out of order on purpose
+        status, _, _ = client.request(
+            "PUT", "/mpb/x",
+            query={"partNumber": str(num), "uploadId": upload_id},
+            body=bytes([num % 251]) * 16,
+        )
+        assert status == 200
+    status, body, _ = client.request(
+        "GET", "/mpb/x", query={"uploadId": upload_id}
+    )
+    nums = [
+        int(find_text(p, "PartNumber"))
+        for p in findall(parse_xml(body), "Part")
+    ]
+    assert nums == [2, 10000], nums
+    client.request("DELETE", "/mpb/x", query={"uploadId": upload_id})
+
+
+def test_list_objects_max_keys_zero_not_truncated(client):
+    """max-keys=0 is an empty NON-truncated listing; IsTruncated=true with
+    an empty continuation token would trap v2 paginators in a loop."""
+    client.create_bucket("mk0")
+    client.put_object("mk0", "a.txt", b"1")
+    for q in ({"max-keys": "0"}, {"list-type": "2", "max-keys": "0"}):
+        status, body, _ = client.request("GET", "/mk0", query=q)
+        assert status == 200
+        root = parse_xml(body)
+        assert find_text(root, "IsTruncated") == "false", body
+        assert not findall(root, "Contents")
+
+
 def test_multipart_abort(client):
     client.create_bucket("mpa")
     status, body, _ = client.request("POST", "/mpa/x", query={"uploads": ""})
