@@ -501,8 +501,8 @@ class S3ApiServer:
             return _err("InvalidArgument", key,
                         "partNumber must be an integer")
         if not 1 <= part <= 10000:
-            # AWS bounds (the completed-upload concatenation sorts by part
-            # number, and the part file name is a 4-digit field)
+            # AWS bounds; the part file name is a 5-digit field, so name
+            # order == numeric order across the whole range
             return _err("InvalidArgument", key,
                         "partNumber must be between 1 and 10000")
         if self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/.info") is None:
@@ -583,6 +583,10 @@ class S3ApiServer:
             ]
         except Exception:
             return _err("MalformedXML", key)
+        if len(set(part_numbers)) != len(part_numbers):
+            # a duplicated PartNumber would assemble that part's chunks
+            # twice; AWS rejects the request rather than guessing
+            return _err("InvalidPart", key, "duplicate part number")
         chunks, md5_digests, offset = [], [], 0
         for part in sorted(part_numbers):
             pe = self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/{part:05d}.part")

@@ -189,22 +189,29 @@ class VolumeServer:
                     from ..util.compression import ungzip_data
 
                     data = ungzip_data(data)
-            if q.get("width") or q.get("height"):
+            def _dim(key):
+                # the reference ignores Atoi failures (resizing.go) —
+                # ?width=zz serves the original bytes, it doesn't fail the
+                # read; the Range gate below must see the same parsed view
+                # or an ignored parameter would silently disable 206s
+                try:
+                    return int(q[key]) if q.get(key) else None
+                except ValueError:
+                    return None
+
+            width, height = _dim("width"), _dim("height")
+            if width or height:
                 # on-read auto-resize for image needles (images/resizing.go)
                 from ..util import images
 
                 mime = n.mime.decode() if n.mime else "image/jpeg"
                 data = images.resized(
-                    data,
-                    mime,
-                    int(q["width"]) if q.get("width") else None,
-                    int(q["height"]) if q.get("height") else None,
-                    q.get("mode", ""),
+                    data, mime, width, height, q.get("mode", ""),
                 )
             rng = h.headers.get("Range", "")
             if (
                 rng
-                and not (q.get("width") or q.get("height"))
+                and not (width or height)
                 and not serving_gzip  # ranges address the plaintext bytes
             ):
                 return self._range_reply(h, data, rng)

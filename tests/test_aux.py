@@ -279,6 +279,21 @@ def test_volume_image_resize(mini):
     status, data = http_bytes("GET", f"http://{a.url}/{a.fid}?width=40")
     assert status == 200
     assert Image.open(io.BytesIO(data)).size == (40, 20)
+    # garbage dimensions serve the original bytes, not a 500 — the
+    # reference ignores Atoi failures (resizing.go)
+    status, data = http_bytes("GET", f"http://{a.url}/{a.fid}?width=zz")
+    assert status == 200
+    assert Image.open(io.BytesIO(data)).size == (80, 40)
+    # ... and an ignored dimension must not disable Range serving: the
+    # request behaves exactly as if the parameter were absent
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{a.url}/{a.fid}?width=zz", headers={"Range": "bytes=0-3"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 206
+        assert len(resp.read()) == 4
 
 
 def test_query_executes_on_the_volume_server(mini, monkeypatch):

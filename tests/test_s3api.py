@@ -229,6 +229,18 @@ def test_multipart_part_number_bounds_and_ordering(client):
         for p in findall(parse_xml(body), "Part")
     ]
     assert nums == [2, 10000], nums
+    # a duplicated PartNumber in the Complete XML must be rejected, not
+    # assembled twice into the object
+    dup = (
+        b"<CompleteMultipartUpload>"
+        b"<Part><PartNumber>2</PartNumber></Part>"
+        b"<Part><PartNumber>2</PartNumber></Part>"
+        b"</CompleteMultipartUpload>"
+    )
+    status, body, _ = client.request(
+        "POST", "/mpb/x", query={"uploadId": upload_id}, body=dup
+    )
+    assert status == 400 and b"InvalidPart" in body
     client.request("DELETE", "/mpb/x", query={"uploadId": upload_id})
 
 
