@@ -21,7 +21,13 @@ import uuid
 from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler
 
-from ..server.http_util import CountedReader, drain_refused_body, relay_stream, start_server
+from ..server.http_util import (
+    CountedReader,
+    drain_refused_body,
+    parse_content_length,
+    relay_stream,
+    start_server,
+)
 from . import auth as s3auth
 from . import policy_engine as pe
 from . import post_policy as pp
@@ -1082,7 +1088,19 @@ class S3ApiServer:
                         parsed.query, keep_blank_values=True
                     ).items()
                 }
-                length = int(self.headers.get("Content-Length") or 0)
+                length = parse_content_length(self.headers)
+                if length < 0:
+                    # framing is unknowable → 400 and drop the connection
+                    self.close_connection = True
+                    data = error_xml(
+                        "IncompleteBody", "bad Content-Length", parsed.path
+                    )
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/xml")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 headers = {k.title(): v for k, v in self.headers.items()}
                 # stream-eligible object PUT: auth never needs the bytes
                 # (unsigned/absent payload hash) and no sub-resource is

@@ -99,6 +99,22 @@ class StreamBody:
         self.chunks = chunks
 
 
+def parse_content_length(headers) -> int:
+    """Content-Length as a non-negative int, or -1 when garbage/negative.
+
+    A naive ``int(...)`` feeds ``rfile.read(-N)``, which blocks until the
+    peer hangs up and pins the handler thread. Callers treat -1 as a 400 +
+    close (the body framing is unknowable). Shared by every HTTP handler
+    (JsonHandler dispatch, the S3 gateway, WebDAV) so hardening lands once.
+    """
+    raw = (headers.get("Content-Length") or "0").strip()
+    # ascii-digits only: rejects '-5', '+5', '1_0', 'zz', '' and the
+    # unicode digits ('²') where isdigit() and int() disagree
+    if not (raw.isascii() and raw.isdigit()):
+        return -1
+    return int(raw)
+
+
 class JsonHandler(BaseHTTPRequestHandler):
     """Route table based handler; subclasses set `routes` as
     [(method, path_prefix, fn)] where fn(handler, path, query, body) →
@@ -129,7 +145,13 @@ class JsonHandler(BaseHTTPRequestHandler):
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
         query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
-        length = int(self.headers.get("Content-Length") or 0)
+        length = parse_content_length(self.headers)
+        if length < 0:
+            # body framing is unknowable, so answer 400 and drop the
+            # connection
+            self.close_connection = True
+            self._reply(400, {"error": "bad Content-Length"})
+            return
         body = None  # read lazily: streaming handlers consume rfile directly
         for m, prefix, fn in self.routes:
             if m == method and parsed.path.startswith(prefix):

@@ -176,12 +176,24 @@ class VolumeServer:
                 # server-side chunked-file resolution
                 # (volume_server_handlers_read.go:181)
                 return self._serve_chunked_manifest(h, n, data)
+            def _dim(key):
+                # the reference ignores Atoi failures (resizing.go) —
+                # ?width=zz serves the original bytes, it doesn't fail the
+                # read; the gzip and Range gates below must see the same
+                # parsed view, or an ignored parameter would silently
+                # disable gzip passthrough / 206s
+                try:
+                    return int(q[key]) if q.get(key) else None
+                except ValueError:
+                    return None
+
+            width, height = _dim("width"), _dim("height")
             serving_gzip = False
             if n.is_compressed:
                 # serve gzip verbatim only to clients that asked for it;
                 # everyone else gets the original bytes
                 if "gzip" in h.headers.get("Accept-Encoding", "") and not (
-                    q.get("width") or q.get("height")
+                    width or height
                 ):
                     h.extra_headers = {"Content-Encoding": "gzip"}
                     serving_gzip = True
@@ -189,17 +201,6 @@ class VolumeServer:
                     from ..util.compression import ungzip_data
 
                     data = ungzip_data(data)
-            def _dim(key):
-                # the reference ignores Atoi failures (resizing.go) —
-                # ?width=zz serves the original bytes, it doesn't fail the
-                # read; the Range gate below must see the same parsed view
-                # or an ignored parameter would silently disable 206s
-                try:
-                    return int(q[key]) if q.get(key) else None
-                except ValueError:
-                    return None
-
-            width, height = _dim("width"), _dim("height")
             if width or height:
                 # on-read auto-resize for image needles (images/resizing.go)
                 from ..util import images

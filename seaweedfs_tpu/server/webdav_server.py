@@ -25,7 +25,13 @@ from http.server import BaseHTTPRequestHandler
 
 from ..filer.client import FilerClient
 from ..util.safe_xml import safe_fromstring
-from .http_util import CountedReader, drain_refused_body, relay_stream, start_server
+from .http_util import (
+    CountedReader,
+    drain_refused_body,
+    parse_content_length,
+    relay_stream,
+    start_server,
+)
 
 DAV_NS = "DAV:"
 
@@ -531,7 +537,14 @@ class WebDavServer:
 
             def _go(self, method):
                 parsed = urllib.parse.urlparse(self.path)
-                length = int(self.headers.get("Content-Length") or 0)
+                length = parse_content_length(self.headers)
+                if length < 0:
+                    # framing is unknowable → 400 and drop the connection
+                    self.close_connection = True
+                    self.send_response(400)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 reader = None
                 if method == "PUT":
                     # stream PUT bodies straight through to the filer

@@ -77,6 +77,16 @@ def test_raw_socket_garbage(stack):
         if p is None:
             p = bytes(rng.randrange(256) for _ in range(150))
         _poke(port, p, read_timeout=0.3)
+    # negative/garbage Content-Length must answer 400 promptly — a naive
+    # rfile.read(-N) would pin the handler thread until the peer hung up
+    for cl in (b"-5", b"zz", b"-99999999"):
+        out = _poke(
+            port,
+            b"PUT /b/k HTTP/1.1\r\nHost: x\r\nContent-Length: " + cl
+            + b"\r\n\r\n",
+            read_timeout=2.0,
+        )
+        assert b" 400 " in out.split(b"\r\n", 1)[0], (cl, out[:80])
     c = S3Client(f"http://{stack.url}", "AK", "SK")
     st, _, _ = c.create_bucket("alive")
     assert st == 200

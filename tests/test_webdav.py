@@ -369,3 +369,23 @@ def test_streamed_large_put_roundtrip(webdav):
     conn.close()
     dav("UNLOCK", f"http://{webdav.url}/big/stream.bin", b"",
         {"Lock-Token": f"<{token}>"})
+
+
+def test_bad_content_length_is_400(webdav):
+    """Negative/garbage Content-Length answers 400 promptly instead of
+    rfile.read(-N) pinning the handler thread until the peer hangs up."""
+    import socket as _socket
+
+    host, port = webdav.url.split(":")
+    for cl in (b"-5", b"zz"):
+        s = _socket.create_connection((host, int(port)), timeout=5)
+        try:
+            s.sendall(
+                b"PUT /f.txt HTTP/1.1\r\nHost: x\r\nContent-Length: " + cl
+                + b"\r\n\r\n"
+            )
+            s.settimeout(3.0)
+            first = s.recv(256).split(b"\r\n", 1)[0]
+            assert b" 400 " in first, (cl, first)
+        finally:
+            s.close()
