@@ -38,6 +38,11 @@ def _join(cwd: str, arg: str) -> str:
     return "/" + "/".join(parts)
 
 
+# RFC 959 lines are short; 8KB leaves room for deep paths while bounding
+# what a hostile newline-free stream can make the command reader buffer
+_MAX_CMD_LINE = 8192
+
+
 class _Session(threading.Thread):
     def __init__(self, srv: "FtpServer", conn: socket.socket, addr):
         super().__init__(daemon=True)
@@ -106,9 +111,14 @@ class _Session(threading.Thread):
         try:
             self.send(220, "seaweedfs_tpu FTP gateway ready.")
             while True:
-                raw = self._rfile.readline()
+                # bounded: an unbounded readline() on a newline-free byte
+                # stream would buffer the peer's entire output in memory
+                raw = self._rfile.readline(_MAX_CMD_LINE)
                 if not raw:
                     return
+                if len(raw) >= _MAX_CMD_LINE and not raw.endswith(b"\n"):
+                    self.send(500, "Command line too long.")
+                    return  # framing is gone; drop the session
                 line = raw.decode("utf-8", "replace").rstrip("\r\n")
                 verb, _, arg = line.partition(" ")
                 handler = getattr(self, f"do_{verb.upper()}", None)

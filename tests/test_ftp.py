@@ -206,3 +206,73 @@ def test_anonymous_mode(tmp_path):
         filer.stop()
         volume.stop()
         master.stop()
+
+
+def test_control_channel_garbage(cluster):
+    """Hostile control-channel traffic — binary garbage, newline-free
+    streams, commands with missing/malformed args, abrupt disconnects —
+    must never take the daemon down or wedge the next session."""
+    import random
+
+    ftp_srv = cluster
+    rng = random.Random(0xF7B)
+    host, port = ftp_srv.host, ftp_srv.port
+    payloads = [
+        b"\x00\xff\xfe\r\n",
+        b"USER\r\nPASS\r\n",                    # args missing
+        b"A" * 70000 + b"\r\n",                 # line past the 8KB cap
+        b"PORT 1,2,3\r\n",                      # malformed PORT
+        b"RETR\r\nSTOR\r\nDELE\r\nCWD\r\n",     # unauthenticated verbs
+        b"USER weed\r\nPASS wrong\r\nRETR /x\r\n",
+        b"REST zz\r\nSIZE\r\nMDTM\r\n",
+        None,                                   # raw binary, per-round
+    ]
+    for _ in range(60):
+        p = payloads[rng.randrange(len(payloads))]
+        if p is None:
+            p = bytes(rng.randrange(256) for _ in range(120))
+        s = socket.create_connection((host, port), timeout=5)
+        try:
+            s.sendall(p)
+            s.settimeout(0.05)
+            try:
+                s.recv(4096)  # one bounded read; don't drain-until-timeout
+            except socket.timeout:
+                pass
+        finally:
+            s.close()
+    # a newline-free mega-stream must be answered 500 and dropped, not
+    # buffered without bound (the command reader caps the line at 8KB).
+    # The server may RST while we are still sending — a connection error
+    # counts as "dropped"; the _login below proves the daemon survived.
+    s = socket.create_connection((host, port), timeout=5)
+    dropped = False
+    got = b""
+    try:
+        s.settimeout(5.0)
+        s.recv(256)  # banner
+        for _ in range(128):  # 128 × 8KB = 1MB, no newline anywhere
+            s.sendall(b"B" * 8192)
+            s.settimeout(0.05)
+            try:
+                chunk = s.recv(4096)
+                if not chunk:
+                    dropped = True
+                    break
+                got += chunk
+            except socket.timeout:
+                pass
+            if b"500" in got:
+                break
+    except OSError:
+        dropped = True
+    finally:
+        s.close()
+    assert b"500" in got or dropped, got[:120]
+    # a fresh well-formed session still works end to end
+    c = _login(cluster)
+    c.storbinary("STOR alive.txt", io.BytesIO(b"alive"))
+    out = io.BytesIO()
+    c.retrbinary("RETR alive.txt", out.write)
+    assert out.getvalue() == b"alive"
+    c.quit()
