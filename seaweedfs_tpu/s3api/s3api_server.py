@@ -892,6 +892,16 @@ class S3ApiServer:
             # dot-prefixed names would collide with the gateway's internal
             # dirs under /buckets (.uploads); S3 names start alphanumeric
             return _err("InvalidBucketName", path)
+        if method in ("PUT", "POST") and any(
+            seg in (".", "..") for seg in key.split("/")
+        ):
+            # keys are filer paths here: the filer refuses literal "."/".."
+            # segments on writes (unrepresentable through the FUSE mount),
+            # so answer the client's error shape instead of wrapping the
+            # filer's 400. GET/DELETE stay literal — pre-existing artifacts
+            # remain readable and deletable so buckets can be emptied.
+            return _err("InvalidArgument", path,
+                        "key must not contain '.' or '..' path segments")
 
         def allowed(action, s3_action="", obj_key=None):
             # resource policy first (explicit Deny wins, Allow grants even

@@ -363,6 +363,22 @@ class FilerServer:
         (rename/link/meta/mkdir) buffer their small bodies and take the
         plain path."""
         parsed_path = urllib.parse.unquote(path)
+        targets = [parsed_path, q.get("mv.to", ""), q.get("link.to", "")]
+        if any(
+            seg in (".", "..")
+            for t in targets if t
+            for seg in t.split("/")
+        ):
+            # the filer stores path segments literally (no resolution, so
+            # no traversal), but a literal "." / ".." entry is
+            # unrepresentable through the FUSE mount and poisons POSIX
+            # listings on every gateway above — refuse at the chokepoint
+            # they all share. The unconsumed body is drained bounded and
+            # timeout-guarded (a stalling client must not pin the worker).
+            from .http_util import CountedReader, drain_refused_body
+
+            drain_refused_body(h, CountedReader(rfile, length))
+            return 400, {"error": "dot path segments not allowed"}
         meta_shaped = (
             q.get("mv.to") or q.get("link.to") or q.get("meta") == "true"
             or parsed_path.endswith("/")

@@ -196,3 +196,31 @@ def test_meta_watch_garbage_params_return_promptly(cluster):
         # all three fall back to wait_s=0 (nan/negative/unparseable): the
         # reply must be immediate, not a spin and not the 30s long-poll cap
         assert dt < 5.0, (qs, dt)
+
+
+def test_dot_path_segments_refused_on_write(cluster):
+    """Literal '.'/'..' path segments are refused on every write shape:
+    the filer stores segments literally (no resolution — no traversal),
+    but a stored '..' entry is unrepresentable through the FUSE mount and
+    poisons POSIX listings on every gateway. Reads/deletes still work so
+    pre-existing artifacts stay reachable for cleanup."""
+    from seaweedfs_tpu.server.http_util import http_bytes, http_json
+
+    _, _, filer = cluster
+    for path in ("/b/../x", "/b/./x", "/../x", "/b/..", "/b/../"):
+        st, body = http_bytes(
+            "POST", f"http://{filer.url}{path}", b"data"
+        )
+        assert st == 400, (path, st, body[:80])
+    # rename target is a write target too
+    st, _ = http_bytes("POST", f"http://{filer.url}/ok.txt", b"d")
+    assert st == 201
+    r = http_json(
+        "POST", f"http://{filer.url}/ok.txt?mv.to=/b/../stolen.txt"
+    )
+    assert r.get("error"), r
+    # names merely containing dots remain legal
+    st, _ = http_bytes("POST", f"http://{filer.url}/b/..x.txt", b"d")
+    assert st == 201
+    st, data = http_bytes("GET", f"http://{filer.url}/b/..x.txt")
+    assert (st, data) == (200, b"d")
