@@ -23,6 +23,7 @@ from http.server import BaseHTTPRequestHandler
 
 from ..server.http_util import (
     CountedReader,
+    has_dot_segments,
     drain_refused_body,
     parse_content_length,
     relay_stream,
@@ -708,6 +709,12 @@ class S3ApiServer:
         if "${filename}" in key:
             key = key.replace("${filename}", file_name)
             values["key"] = key
+        if has_dot_segments(key):
+            # same guard the PUT path applies in handle(): the filer will
+            # refuse the write, so answer the client's 400 shape here
+            # instead of wrapping the filer's
+            return _err("InvalidArgument", f"/{bucket}/{key}",
+                        "key must not contain '.' or '..' path segments")
 
         identity = None
         access_key = ""
@@ -892,9 +899,7 @@ class S3ApiServer:
             # dot-prefixed names would collide with the gateway's internal
             # dirs under /buckets (.uploads); S3 names start alphanumeric
             return _err("InvalidBucketName", path)
-        if method in ("PUT", "POST") and any(
-            seg in (".", "..") for seg in key.split("/")
-        ):
+        if method in ("PUT", "POST") and has_dot_segments(key):
             # keys are filer paths here: the filer refuses literal "."/".."
             # segments on writes (unrepresentable through the FUSE mount),
             # so answer the client's error shape instead of wrapping the

@@ -40,7 +40,12 @@ from ..filer.filer import Filer
 from ..filer.filerstore import NotFoundError, SqliteStore
 from ..util import glog
 from ..wdclient import MasterClient
-from .http_util import JsonHandler, http_json, start_server
+from .http_util import (
+    JsonHandler,
+    has_dot_segments,
+    http_json,
+    start_server,
+)
 
 
 class _VidLookup:
@@ -364,11 +369,7 @@ class FilerServer:
         plain path."""
         parsed_path = urllib.parse.unquote(path)
         targets = [parsed_path, q.get("mv.to", ""), q.get("link.to", "")]
-        if any(
-            seg in (".", "..")
-            for t in targets if t
-            for seg in t.split("/")
-        ):
+        if any(has_dot_segments(t) for t in targets if t):
             # the filer stores path segments literally (no resolution, so
             # no traversal), but a literal "." / ".." entry is
             # unrepresentable through the FUSE mount and poisons POSIX

@@ -99,6 +99,17 @@ class StreamBody:
         self.chunks = chunks
 
 
+def has_dot_segments(path: str) -> bool:
+    """True when any "/"-separated segment is literally "." or "..".
+
+    The filer stores segments literally (no resolution — no traversal),
+    but a stored ".." entry is unrepresentable through the FUSE mount and
+    poisons POSIX listings; the filer refuses such writes and the gateways
+    answer their own error shapes. One predicate so the notion of an
+    illegal path cannot drift between them."""
+    return any(seg in (".", "..") for seg in path.split("/"))
+
+
 def parse_content_length(headers) -> int:
     """Content-Length as a non-negative int, or -1 when garbage/negative.
 

@@ -66,8 +66,8 @@ def test_every_test_file_cited_exists_and_most_are_cited():
     }
     # doc-rot checks and the perf-table check are meta, not components
     meta = {"tests/test_parity_doc.py", "tests/test_wire_doc.py",
-            "tests/test_perf_table.py", "tests/test_advice_fixes.py",
-            "tests/test_integration_stores.py"}
+            "tests/test_shell_parity_doc.py", "tests/test_perf_table.py",
+            "tests/test_advice_fixes.py", "tests/test_integration_stores.py"}
     uncited = sorted(actual - cited - meta)
     assert not uncited, (
         "test files not reachable from PARITY.md (add a row or extend "

@@ -511,3 +511,33 @@ def test_anonymous_post_via_bucket_policy_allow(s3, admin):
     status, _ = anon_request(f"http://{s3.url}/dropbox/anon.bin")
     assert status == 403
     admin.request("DELETE", "/dropbox", query={"policy": ""})
+
+
+def test_post_form_dot_segment_key_rejected(s3, admin):
+    """The browser form-POST path is routed before handle()'s key guard;
+    it must apply the same dot-segment rejection (400 InvalidArgument),
+    not wrap the filer's refusal as a 500 — including when the dots
+    arrive via the ${filename} substitution."""
+    admin.create_bucket("formdots")
+    doc = json.dumps({"Version": "2012-10-17", "Statement": [{
+        "Effect": "Allow", "Principal": "*", "Action": "s3:PutObject",
+        "Resource": "arn:aws:s3:::formdots/*"}]}).encode()
+    status, _, _ = admin.request(
+        "PUT", "/formdots", query={"policy": ""}, body=doc
+    )
+    assert status == 204
+    status, body, _ = post_form(
+        f"http://{s3.url}/formdots", {"key": "../escape.bin"}, b"x"
+    )
+    assert status == 400 and b"InvalidArgument" in body, (status, body[:120])
+    status, body, _ = post_form(
+        f"http://{s3.url}/formdots", {"key": "up/${filename}"}, b"x",
+        filename="..",
+    )
+    assert status == 400 and b"InvalidArgument" in body, (status, body[:120])
+    # sane keys still upload
+    status, _, _ = post_form(
+        f"http://{s3.url}/formdots", {"key": "ok.bin"}, b"fine"
+    )
+    assert status == 204
+    admin.request("DELETE", "/formdots", query={"policy": ""})
