@@ -1,0 +1,182 @@
+"""Cross-gateway coherence: every gateway is a view of ONE filer tree.
+
+A SeaweedFS user expects an object PUT through S3 to appear at
+/buckets/<bucket>/<key> through the mount, WebDAV, FTP and the filer HTTP
+surface — and writes made through those gateways to be readable back via
+S3 (the reference's weed server stacks all gateways on one filer; the
+soak exercises them concurrently but only checks each against itself).
+"""
+
+import ftplib
+import io
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.s3api import IAM, Identity, S3ApiServer
+from seaweedfs_tpu.s3api.s3_client import S3Client
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.ftp_server import FtpServer
+from seaweedfs_tpu.server.http_util import http_bytes
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.server.webdav_server import WebDavServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("crossgw")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp / "v")], port=free_port(), master_url=master.url,
+        max_volume_count=20, pulse_seconds=0.5,
+    ).start()
+    filer = FilerServer(
+        port=free_port(), master_url=master.url, chunk_size=64 * 1024
+    ).start()
+    s3 = S3ApiServer(
+        port=free_port(), filer_url=filer.url,
+        iam=IAM([Identity("admin", "AK", "SK", ["Admin"])]),
+    ).start()
+    dav = WebDavServer(port=free_port(), filer_url=filer.url).start()
+    ftp = FtpServer(
+        port=free_port(), filer_url=filer.url, users={"u": "p"}
+    ).start()
+    time.sleep(0.6)
+    yield {"filer": filer, "s3": s3, "dav": dav, "ftp": ftp}
+    ftp.stop()
+    dav.stop()
+    s3.stop()
+    filer.stop()
+    volume.stop()
+    master.stop()
+
+
+def _dav_get(dav, path):
+    with urllib.request.urlopen(
+        f"http://{dav.url}{path}", timeout=10
+    ) as resp:
+        return resp.status, resp.read()
+
+
+def _ftp_get(ftp_srv, path):
+    c = ftplib.FTP()
+    c.connect(ftp_srv.host, ftp_srv.port, timeout=10)
+    c.login("u", "p")
+    out = io.BytesIO()
+    c.retrbinary(f"RETR {path}", out.write)
+    c.quit()
+    return out.getvalue()
+
+
+def _ftp_put(ftp_srv, path, data):
+    c = ftplib.FTP()
+    c.connect(ftp_srv.host, ftp_srv.port, timeout=10)
+    c.login("u", "p")
+    c.storbinary(f"STOR {path}", io.BytesIO(data))
+    c.quit()
+
+
+def test_s3_object_visible_through_every_gateway(stack):
+    c3 = S3Client(f"http://{stack['s3'].url}", "AK", "SK")
+    st, _, _ = c3.create_bucket("xgw")
+    assert st == 200
+    payload = b"one tree, many doors" * 100
+    st, _, _ = c3.put_object("xgw", "dir/shared.bin", payload)
+    assert st == 200
+
+    # filer HTTP
+    st, data = http_bytes(
+        "GET", f"http://{stack['filer'].url}/buckets/xgw/dir/shared.bin"
+    )
+    assert (st, data) == (200, payload)
+    # WebDAV
+    st, data = _dav_get(stack["dav"], "/buckets/xgw/dir/shared.bin")
+    assert (st, data) == (200, payload)
+    # FTP
+    assert _ftp_get(stack["ftp"], "/buckets/xgw/dir/shared.bin") == payload
+
+
+def test_ftp_write_readable_via_s3_and_dav(stack):
+    c3 = S3Client(f"http://{stack['s3'].url}", "AK", "SK")
+    c3.create_bucket("xgw2")
+    _ftp_put(stack["ftp"], "/buckets/xgw2/from-ftp.txt", b"ftp wrote this")
+    st, data, _ = c3.get_object("xgw2", "from-ftp.txt")
+    assert (st, data) == (200, b"ftp wrote this")
+    st, data = _dav_get(stack["dav"], "/buckets/xgw2/from-ftp.txt")
+    assert (st, data) == (200, b"ftp wrote this")
+
+
+def test_dav_rename_visible_via_s3(stack):
+    c3 = S3Client(f"http://{stack['s3'].url}", "AK", "SK")
+    c3.create_bucket("xgw3")
+    c3.put_object("xgw3", "old.txt", b"renamed across gateways")
+    req = urllib.request.Request(
+        f"http://{stack['dav'].url}/buckets/xgw3/old.txt",
+        method="MOVE",
+        headers={
+            "Destination": f"http://{stack['dav'].url}/buckets/xgw3/new.txt"
+        },
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status in (201, 204)
+    st, _, _ = c3.get_object("xgw3", "old.txt")
+    assert st == 404
+    st, data, _ = c3.get_object("xgw3", "new.txt")
+    assert (st, data) == (200, b"renamed across gateways")
+
+
+def test_mount_sees_s3_objects(stack, tmp_path):
+    """The kernel FUSE mount exports the same /buckets tree (skips when
+    the environment refuses FUSE). Kernel-side IO runs in a subprocess —
+    never VFS-touch a mount serviced by this process's threads."""
+    from seaweedfs_tpu.mount.fuse_mount import FuseMount, fuse_available
+    from seaweedfs_tpu.mount.wfs import WFS
+
+    if not fuse_available():
+        pytest.skip("FUSE not available")
+    c3 = S3Client(f"http://{stack['s3'].url}", "AK", "SK")
+    c3.create_bucket("xgwm")
+    c3.put_object("xgwm", "via-s3.txt", b"mount sees s3")
+
+    mnt = str(tmp_path / "mnt")
+    wfs = WFS(stack["filer"].url)
+    fm = FuseMount(wfs, mnt).mount()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys;print(open(sys.argv[1],'rb').read().decode())",
+             os.path.join(mnt, "buckets/xgwm/via-s3.txt")],
+            capture_output=True, text=True, timeout=30,
+            env=dict(os.environ, PYTHONPATH=REPO),
+        )
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.strip() == "mount sees s3"
+        # and a kernel-side write surfaces in S3
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys;open(sys.argv[1],'wb').write(b'kernel wrote')",
+             os.path.join(mnt, "buckets/xgwm/via-mount.txt")],
+            capture_output=True, text=True, timeout=30,
+        )
+        assert r.returncode == 0, r.stderr
+        st, data, _ = c3.get_object("xgwm", "via-mount.txt")
+        assert (st, data) == (200, b"kernel wrote")
+    finally:
+        fm.unmount()
+        wfs.close()
