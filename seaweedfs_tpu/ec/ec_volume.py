@@ -49,6 +49,12 @@ class DeletedError(Exception):
     pass
 
 
+class EcShardsError(Exception):
+    """The local shard set is not safe to serve: shard sizes disagree (a
+    torn write survived) or an encode commit is still pending for this
+    volume. Mounting anyway would serve a half-consistent stripe view."""
+
+
 def search_sorted_index(
     f, file_size: int, needle_id: int, offset_size: int = OFFSET_SIZE
 ) -> tuple[Optional[tuple[int, int, int]], int]:
@@ -130,18 +136,43 @@ class EcVolume:
         self.shards: dict[int, EcVolumeShard] = {}
         self._ecx_lock = threading.Lock()
         self._ecj_lock = threading.Lock()
+        from ..storage.commit import pending_commit
+
+        if pending_commit(self.base_file_name):
+            # an encode/vacuum/tier transition for this volume never reached
+            # its cleanup step; startup recovery resolves it — mounting now
+            # could see the shard set mid-rename
+            raise EcShardsError(
+                f"volume {vid} has a pending commit manifest"
+            )
         ecx_path = self.base_file_name + ".ecx"
         if not os.path.exists(ecx_path):
             raise FileNotFoundError(ecx_path)
         self._ecx = open(ecx_path, "r+b")
         self.ecx_size = os.path.getsize(ecx_path)
-        self._load_shards()
+        try:
+            self._load_shards()
+        except EcShardsError:
+            self._ecx.close()
+            raise
 
     def _load_shards(self) -> None:
         for sid in range(self.total_shards):
             path = self.base_file_name + shard_ext(sid)
             if os.path.exists(path) and sid not in self.shards:
                 self.shards[sid] = EcVolumeShard(self.base_file_name, sid)
+        # completeness: every RS stripe column spans all shards, so local
+        # shard files must agree on size; a short one is a torn write that
+        # escaped the commit protocol (manual copy, fs corruption) and
+        # would silently corrupt reads and reconstructions
+        sizes = {s.size for s in self.shards.values()}
+        if len(sizes) > 1:
+            raise EcShardsError(
+                f"volume {self.id} shard sizes disagree: "
+                + ", ".join(
+                    f"{sid}:{s.size}" for sid, s in sorted(self.shards.items())
+                )
+            )
 
     def refresh_shards(self) -> None:
         self._load_shards()

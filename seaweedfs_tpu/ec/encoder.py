@@ -26,6 +26,7 @@ import numpy as np
 
 from ..storage import idx as idx_mod
 from ..storage.types import OFFSET_SIZE, TOMBSTONE_FILE_SIZE
+from ..util import faultpoints
 from .codec import Codec, get_codec
 from .constants import (
     LARGE_BLOCK_SIZE,
@@ -271,8 +272,15 @@ def write_ec_files(
     chunk_bytes: Optional[int] = None,
     pipeline_stats: Optional[dict] = None,
     plan: Optional[tuple] = None,
+    suffix: str = "",
 ) -> None:
     """Generate all shard files from ``base.dat`` (WriteEcFiles, :57).
+
+    ``suffix`` — appended to every shard file name. The crash-safe commit
+    path (Store.ec_encode_volume) passes ``".tmp"`` so the shard set is
+    staged and only appears under its final names after the commit
+    manifest is durable; the bare call writes final names directly (tools,
+    tests, bench).
 
     ``plan`` — a ``(chunk, items)`` pair from :func:`plan_encode` for the
     same volume. Callers that pre-warmed kernel shapes against a plan
@@ -299,7 +307,10 @@ def write_ec_files(
         codec, dat_size, large_block_size, small_block_size, chunk_bytes
     )
 
-    outputs = [open(base_file_name + shard_ext(i), "wb") for i in range(k + m)]
+    outputs = [
+        open(base_file_name + shard_ext(i) + suffix, "wb")
+        for i in range(k + m)
+    ]
     try:
         if hasattr(codec, "matmul_device"):
             _encode_pipelined(dat, items, codec, outputs, dat_size,
@@ -307,6 +318,7 @@ def write_ec_files(
         else:
             with open(dat, "rb") as f:
                 for item in items:
+                    faultpoints.fire("ec.encode.chunk", path=outputs[0].name)
                     width = _item_width(item)
                     data, has_data = _read_item(f, item, k, dat_size)
                     if not has_data or not data.any():
@@ -494,6 +506,7 @@ def _encode_pipelined(dat, items, codec, outputs, dat_size: int,
         return width, data, np.asarray(parity_dev)
 
     def consume(got):
+        faultpoints.fire("ec.encode.chunk", path=outputs[0].name)
         width, data, parity = got
         if parity is None:
             for o in outputs:  # keep sparse regions sparse (holes)

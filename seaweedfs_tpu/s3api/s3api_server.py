@@ -56,8 +56,19 @@ _ERR_STATUS = {
     "BucketAlreadyExists": 409,
     "BucketNotEmpty": 409,
     "NoSuchBucketPolicy": 404,
+    "AuthorizationHeaderMalformed": 400,
     "InternalError": 500,
 }
+
+
+def _parse_s3_int(s: str) -> int:
+    """AWS-strict non-negative query integer: ascii digits only. Plain
+    int() accepts '+5', ' 5 ', '1_0' — values AWS rejects — so every S3
+    query int (max-keys, partNumber) parses through here, matching the
+    strict rule parse_content_length applies to bodies."""
+    if not (s.isascii() and s.isdigit()):
+        raise ValueError(f"not a non-negative integer: {s!r}")
+    return int(s)
 
 
 def _iso(ts: float) -> str:
@@ -174,13 +185,10 @@ class S3ApiServer:
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
         try:
-            max_keys = int(q.get("max-keys", 1000))
+            max_keys = _parse_s3_int(q.get("max-keys", "1000"))
         except ValueError:
             return _err("InvalidArgument", bucket,
-                        "max-keys must be an integer")
-        if max_keys < 0:
-            return _err("InvalidArgument", bucket,
-                        "max-keys must be non-negative")
+                        "max-keys must be a non-negative integer")
         if v2:
             marker = q.get("continuation-token", "") or q.get("start-after", "")
         else:
@@ -294,10 +302,16 @@ class S3ApiServer:
         when the request wasn't aws-chunked — or (None, error_response)."""
         if headers.get("X-Amz-Content-Sha256") != s3auth.STREAMING_PAYLOAD:
             return body, None
+        # the streaming auth context is built OUTSIDE the framing try: a
+        # ValueError here (e.g. malformed credential scope unpack) is an
+        # auth/header problem and must not masquerade as IncompleteBody
         try:
-            return s3auth.decode_aws_chunked(
-                body, verify=self.iam.streaming_context(headers)
-            ), None
+            verify = self.iam.streaming_context(headers)
+        except ValueError:
+            return None, _err("AuthorizationHeaderMalformed", key,
+                              "malformed credential scope")
+        try:
+            return s3auth.decode_aws_chunked(body, verify=verify), None
         except s3auth.ChunkSignatureError:
             return None, _err("SignatureDoesNotMatch", key)
         except ValueError:
@@ -503,7 +517,7 @@ class S3ApiServer:
     def _upload_part(self, bucket, key, q, body, headers):
         upload_id = q["uploadId"]
         try:
-            part = int(q["partNumber"])
+            part = _parse_s3_int(q["partNumber"])
         except (KeyError, ValueError):
             return _err("InvalidArgument", key,
                         "partNumber must be an integer")
@@ -598,6 +612,13 @@ class S3ApiServer:
         for part in sorted(part_numbers):
             pe = self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/{part:05d}.part")
             if pe is None:
+                # uploads in flight across the 04d→05d field-width upgrade
+                # stored their parts under the legacy name; completing them
+                # must find (and purge) those too
+                pe = self.client.get_entry(
+                    f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part"
+                )
+            if pe is None:
                 return _err("InvalidPart", str(part))
             md5_digests.append(bytes.fromhex(pe.get("extended", {}).get("md5", "")))
             for c in sorted(pe.get("chunks", []), key=lambda c: c["offset"]):
@@ -618,8 +639,11 @@ class S3ApiServer:
             },
         )
         # parts not referenced by the Complete request would otherwise leak
-        # their chunks — purge them explicitly first
-        wanted = {f"{p:05d}.part" for p in part_numbers}
+        # their chunks — purge them explicitly first (legacy 04d names are
+        # wanted too, so an upgraded-mid-upload part isn't double-purged)
+        wanted = {f"{p:05d}.part" for p in part_numbers} | {
+            f"{p:04d}.part" for p in part_numbers
+        }
         for e in self.client.list(f"{UPLOADS_DIR}/{upload_id}", limit=10001):
             if e["name"].endswith(".part") and e["name"] not in wanted:
                 self.client.delete(f"{UPLOADS_DIR}/{upload_id}/{e['name']}")

@@ -206,11 +206,15 @@ class FilerServer:
     def _qint(q, key, default):
         """Tolerant query-int: garbage falls back to the default, the way
         the reference's handlers treat strconv.Atoi failures — a client's
-        bad parameter must not surface as the daemon's 500."""
+        bad parameter must not surface as the daemon's 500. Negatives fall
+        back too: every caller is a count/limit/timestamp, and a raw
+        ``?limit=-5`` would slice ``events[:-5]`` and silently drop the
+        NEWEST entries."""
         try:
-            return int(q.get(key, default))
+            val = int(q.get(key, default))
         except ValueError:
             return default
+        return val if val >= 0 else default
 
     def _h_assign(self, h, path, q, body):
         """AssignVolume rpc analog (pb/filer.proto): mount and other write-

@@ -695,22 +695,15 @@ class VolumeServer:
 
     def _h_ec_generate(self, h, path, q, body):
         """VolumeEcShardsGenerate (volume_grpc_erasure_coding.go:39): mark
-        readonly, stripe to 14 shards with the TPU/CPU codec, write .ecx/.vif."""
+        readonly, stripe to 14 shards with the TPU/CPU codec, write
+        .ecx/.vif — staged and committed atomically so a crash mid-encode
+        can never leave a half-visible shard set (Store.ec_encode_volume)."""
         vid = int(q["volume"])
-        v = self.store.find_volume(vid)
-        if v is None:
+        try:
+            shards = self.store.ec_encode_volume(vid)
+        except NotFoundError:
             return 404, {"error": "volume not found"}
-        v.read_only = True
-        v.sync()
-        base = v.file_name()
-        encoder.write_ec_files(base, self.store.ec_codec)
-        encoder.write_sorted_file_from_idx(base)
-        encoder.save_volume_info(
-            base + ".vif",
-            version=v.version,
-            replication=str(v.super_block.replica_placement),
-        )
-        return 200, {"shards": list(range(TOTAL_SHARDS))}
+        return 200, {"shards": shards}
 
     def _h_ec_rebuild(self, h, path, q, body):
         vid = int(q["volume"])
@@ -747,8 +740,11 @@ class VolumeServer:
                 if ext in (".vif",):
                     continue
                 return 500, {"error": f"fetch {ext} from {source}: {status}"}
-            with open(base + ext, "wb") as f:
-                f.write(data)
+            # stage + rename: a crash mid-fetch leaves a .tmp the startup
+            # recovery scan GCs, never a short shard under its final name
+            from ..storage.commit import atomic_write
+
+            atomic_write(base + ext, data)
             copied.append(ext)
         return 200, {"copied": copied}
 

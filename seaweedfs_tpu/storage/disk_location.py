@@ -13,7 +13,7 @@ import shutil
 import threading
 from typing import Optional
 
-from ..ec.ec_volume import EcVolume
+from ..ec.ec_volume import EcShardsError, EcVolume
 from .volume import Volume
 
 
@@ -42,10 +42,12 @@ class DiskLocation:
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}
         self._lock = threading.RLock()
+        self._recovered = False
 
     # -- startup loading (disk_location.go:104-160) --------------------------
     def load_existing_volumes(self) -> None:
         with self._lock:
+            self._recover_staged_commits()
             for entry in sorted(os.listdir(self.directory)):
                 path = os.path.join(self.directory, entry)
                 if not os.path.isfile(path):
@@ -70,6 +72,14 @@ class DiskLocation:
                                 self.ec_volumes[vid] = ev
                             else:
                                 ev.close()
+                except EcShardsError as e:
+                    # torn shard set (size mismatch / pending commit): the
+                    # plain volume, if any, still serves; never mount a
+                    # half-consistent EC view
+                    from ..util import glog
+
+                    glog.error("not mounting ec volume %s: %s", base, e)
+                    continue
                 except (ValueError, FileNotFoundError):
                     continue  # not a volume file
                 except KeyError as e:
@@ -79,6 +89,36 @@ class DiskLocation:
 
                     glog.error("skipping volume %s: %s", base, e)
                     continue
+
+    def _recover_staged_commits(self) -> None:
+        """ONCE per process, resolve interrupted two-phase commits BEFORE
+        any volume loads. Startup-only on purpose: load_existing_volumes is
+        also re-run by runtime mount requests, and a re-scan then could
+        garbage-collect the staging files of a compaction or encode that is
+        legitimately in flight.
+
+        Roll-forward/rollback semantics:
+        staged transitions with a durable manifest roll forward (the EC
+        shard set / compacted files / downloaded .dat take their final
+        names), everything else is garbage-collected so the prior state
+        serves untouched (storage/commit.py). A tier download's .tier
+        descriptor removal rides the manifest's remove-list, so roll-forward
+        covers it too."""
+        if self._recovered:
+            return
+        self._recovered = True
+        from ..util import glog
+        from .commit import recover_directory
+
+        actions = recover_directory(self.directory)
+        for kind in ("rolled_forward", "rolled_back"):
+            for item in actions[kind]:
+                glog.info("startup recovery: %s %s", kind, item)
+        if actions["gc"]:
+            glog.info(
+                "startup recovery: garbage-collected %d staged file(s): %s",
+                len(actions["gc"]), ", ".join(actions["gc"]),
+            )
 
     # -- volume management ---------------------------------------------------
     def add_volume(self, volume: Volume) -> None:

@@ -19,9 +19,11 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..ec.codec import Codec, get_codec
-from ..ec.constants import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE
+from ..ec.constants import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, TOTAL_SHARDS, shard_ext
 from ..ec.ec_volume import EcVolume, NeedsShardError
 from ..ec.ec_volume import NotFoundError as EcNotFoundError
+from ..util import faultpoints, glog
+from .commit import StagedCommit
 from .disk_location import DiskLocation
 from .needle import Needle
 from .replica_placement import ReplicaPlacement
@@ -41,11 +43,20 @@ class Store:
         public_url: str = "",
         ec_backend: Optional[str] = None,
         needle_map_kind: str = "dense",
+        remote_fetch_attempts: int = 3,
+        remote_fetch_backoff_s: float = 0.05,
+        remote_fetch_timeout_s: float = 5.0,
     ):
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
         self.needle_map_kind = needle_map_kind
+        # degraded-read remote fetch policy: bounded attempts, exponential
+        # backoff, and a per-range deadline so a wedged peer degrades to
+        # reconstruction instead of hanging the read
+        self.remote_fetch_attempts = remote_fetch_attempts
+        self.remote_fetch_backoff_s = remote_fetch_backoff_s
+        self.remote_fetch_timeout_s = remote_fetch_timeout_s
         self.locations = [
             DiskLocation(d, needle_map_kind=needle_map_kind)
             for d in directories
@@ -268,6 +279,46 @@ class Store:
             return self.read_ec_shard_needle(ev, n)
         raise NotFoundError(f"volume {vid} not found")
 
+    # -- EC encode: crash-safe two-phase commit ------------------------------
+    def ec_encode_volume(self, vid: int) -> list[int]:
+        """Stripe a sealed volume into 14 shards + .ecx + .vif with an
+        all-or-nothing commit (VolumeEcShardsGenerate, hardened).
+
+        Every output is written to a ``.tmp`` staging name; files are
+        fsync'd, a commit manifest is written atomically, and only then do
+        the staged files take their final names (storage/commit.py). A
+        crash anywhere leaves the volume either fully plain-readable (the
+        .dat is untouched; staged files are GC'd at restart) or fully
+        EC-readable (the manifest rolls the rename pass forward). Returns
+        the shard ids generated.
+        """
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        v.read_only = True
+        v.sync()
+        base = v.file_name()
+        from ..ec import encoder
+
+        sc = StagedCommit(base, "ec.encode")
+        for sid in range(TOTAL_SHARDS):
+            sc.stage(base + shard_ext(sid))
+        sc.stage(base + ".ecx")
+        vif_tmp = sc.stage(base + ".vif")
+        try:
+            encoder.write_ec_files(base, self.ec_codec, suffix=".tmp")
+            encoder.write_sorted_file_from_idx(base, ext=".ecx.tmp")
+            encoder.save_volume_info(
+                vif_tmp,
+                version=v.version,
+                replication=str(v.super_block.replica_placement),
+            )
+            sc.commit()
+        except BaseException:
+            sc.abort()
+            raise
+        return list(range(TOTAL_SHARDS))
+
     # -- EC read path (store_ec.go:122-375) ----------------------------------
     def read_ec_shard_needle(self, ev: EcVolume, n: Needle) -> int:
         offset, size, intervals = ev.locate_needle(n.id)
@@ -286,12 +337,46 @@ class Store:
                 LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, ev.data_shards
             )
             # 1. remote shard holder (wired to gRPC by the volume server)
-            if self.remote_shard_reader is not None:
-                data = self.remote_shard_reader(ev.id, sid, soff, interval.size)
-                if data is not None and len(data) == interval.size:
-                    return data
+            data = self._remote_shard_read(ev.id, sid, soff, interval.size)
+            if data is not None:
+                return data
             # 2. degraded mode: reconstruct from sibling shards
             return self._recover_interval(ev, sid, soff, interval.size)
+
+    def _remote_shard_read(
+        self, vid: int, sid: int, offset: int, size: int
+    ) -> Optional[bytes]:
+        """Remote shard fetch with bounded retry/backoff/deadline
+        (store_ec.go readRemoteEcShardInterval, hardened). A flaky peer
+        gets ``remote_fetch_attempts`` tries with exponential backoff; a
+        dead or wedged one costs at most ``remote_fetch_timeout_s`` before
+        the caller falls through to reconstruction. Returns None when the
+        range is unobtainable remotely."""
+        if self.remote_shard_reader is None:
+            return None
+        import time
+
+        deadline = time.monotonic() + self.remote_fetch_timeout_s
+        backoff = self.remote_fetch_backoff_s
+        for attempt in range(max(1, self.remote_fetch_attempts)):
+            try:
+                faultpoints.fire("ec.read.remote-fetch")
+                data = self.remote_shard_reader(vid, sid, offset, size)
+                if data is not None and len(data) == size:
+                    return data
+                # a short range is a failed attempt, not a success
+                data = None
+            except Exception as e:  # peer down / timeout / injected fault
+                glog.warning(
+                    "remote shard %d.%d fetch attempt %d failed: %s",
+                    vid, sid, attempt + 1, e,
+                )
+            now = time.monotonic()
+            if attempt + 1 >= self.remote_fetch_attempts or now + backoff > deadline:
+                return None
+            time.sleep(backoff)
+            backoff = min(backoff * 2, max(0.0, deadline - time.monotonic()))
+        return None
 
     def _recover_interval(
         self, ev: EcVolume, missing_shard: int, offset: int, size: int
@@ -308,8 +393,8 @@ class Store:
             buf = None
             if local is not None:
                 buf = local.read_at(offset, size)
-            elif self.remote_shard_reader is not None:
-                buf = self.remote_shard_reader(ev.id, sid, offset, size)
+            else:
+                buf = self._remote_shard_read(ev.id, sid, offset, size)
             if buf is not None and len(buf) == size:
                 shards[sid] = np.frombuffer(buf, dtype=np.uint8)
                 have += 1

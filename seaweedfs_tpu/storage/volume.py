@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
+from ..util import faultpoints
 from .backend import BackendStorageFile, DiskFile
 from .needle import (
     CURRENT_VERSION,
@@ -656,9 +657,14 @@ class Volume:
                     secret_key=secret_key,
                 )
             tf = self.tier_file()
-            fd = os.open(tf, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
-            with os.fdopen(fd, "w") as f:
-                _json.dump(info, f)
+            # atomic + durable: a crash mid-write must not leave a torn
+            # .tier that poisons the next startup scan — either the old
+            # state (no descriptor, .dat intact) or the new one exists
+            from .commit import atomic_write
+
+            faultpoints.fire("tier.upload.descriptor", path=local)
+            atomic_write(tf, _json.dumps(info).encode(), mode=0o600)
+            faultpoints.fire("tier.upload.committed", path=tf)
             self.data_backend.close()
             self.data_backend = RemoteS3File(
                 endpoint, bucket, key, access_key, secret_key, size=size
@@ -680,6 +686,8 @@ class Volume:
         from .backend import DiskFile
         from ..s3api.s3_client import S3Client
 
+        from .commit import StagedCommit
+
         with self._lock:
             with open(self.tier_file()) as f:
                 info = _json.load(f)
@@ -688,23 +696,29 @@ class Volume:
                 endpoint, access_key or ak, secret_key or sk
             )
             local = self.file_name() + ".dat"
+            # two-phase: the fetched .dat stages as .tmp and the .tier
+            # descriptor's removal rides the commit manifest, so a crash
+            # anywhere leaves the volume either fully tiered (descriptor
+            # intact, staged bytes GC'd at restart) or fully local
+            sc = StagedCommit(self.file_name(), "tier.download")
+            tmp = sc.stage(local)
+            sc.remove_on_commit(self.tier_file())
             try:
                 # ranged-GET pages straight to disk: no whole-volume buffer
                 got = client.get_object_to_file(
-                    info["bucket"], info["key"], local + ".tmp"
+                    info["bucket"], info["key"], tmp
                 )
+                faultpoints.fire("tier.download.fetched", path=tmp)
                 if got != info["size"]:
                     raise VolumeError(
                         f"tier download: got {got} bytes, want {info['size']}"
                     )
+                sc.commit()
             except Exception:
-                if os.path.exists(local + ".tmp"):
-                    os.unlink(local + ".tmp")
+                sc.abort()
                 raise
-            os.replace(local + ".tmp", local)
             self.data_backend.close()
             self.data_backend = DiskFile(local)
-            os.unlink(self.tier_file())
 
     # -- vacuum / compaction (volume_vacuum.go) ------------------------------
     def compact(self, bytes_per_second: int = 0) -> None:
@@ -794,6 +808,7 @@ class Volume:
                         and lv[0] == offset
                         and size_is_valid(lv[1])
                     ):
+                        faultpoints.fire("vacuum.copy", path=base + ".cpd")
                         dst.write(self.data_backend.read_at(offset, total))
                         dst_idx.write(
                             idx_mod.pack_entry(
@@ -858,10 +873,19 @@ class Volume:
     compact2 = compact
 
     def _commit_compact(self, base: str) -> None:
+        """Atomic swap of the compacted pair. The naive two-rename commit
+        had a crash window where the new .dat was live against the OLD .idx
+        (every offset wrong); staging both renames behind one commit
+        manifest makes the swap all-or-nothing across restarts
+        (storage/commit.py)."""
+        from .commit import StagedCommit
+
         self.data_backend.close()
         self.nm.close()
-        os.replace(base + ".cpd", base + ".dat")
-        os.replace(base + ".cpx", base + ".idx")
+        sc = StagedCommit(base, "vacuum")
+        sc.stage(base + ".dat", tmp_path=base + ".cpd")
+        sc.stage(base + ".idx", tmp_path=base + ".cpx")
+        sc.commit()
         self.data_backend = DiskFile(base + ".dat")
         import struct as _struct
 

@@ -1,0 +1,177 @@
+"""Named fault points: deterministic crash/io-error/delay/torn-write injection.
+
+Commit protocols are only as good as the crashes they have survived; this
+registry lets tests (and the chaos soak) arm a failure at an exact protocol
+step. Call sites sprinkle ``faultpoints.fire("vacuum.manifest")`` at each
+step; a disarmed registry costs one dict truthiness check per call, so the
+hooks stay in production code paths (the acceptance bar: EC encode bench
+throughput unchanged with the framework merged).
+
+Kinds
+-----
+``crash``       ``os._exit(CRASH_EXIT_CODE)`` — kill -9 / power loss. No
+                atexit handlers, no buffer flushes: whatever fsync'd is all
+                the restart gets.
+``io-error``    raise :class:`FaultError` (an ``OSError`` with ``EIO``).
+``delay``       ``time.sleep(arg)`` (default 0.05s) — widens race windows.
+``torn-write``  truncate the call site's file to ``arg`` fraction (default
+                0.5) of its current size, then hard-exit — a torn write
+                plus power loss in one step.
+
+Arming
+------
+Programmatic (in-process tests)::
+
+    faultpoints.arm("vacuum.manifest", "crash")
+    faultpoints.arm("ec.read.remote-fetch", "io-error", count=2)
+
+Environment (subprocess crash harnesses — parsed at import)::
+
+    SWEED_FAULTPOINTS="ec.encode.manifest=crash,slowpath=delay:0.2"
+
+Each spec is ``name=kind[:arg[:skip[:count]]]``; ``skip`` hits pass through
+before the fault fires, ``count`` bounds how many times it fires (0 =
+every hit after ``skip``).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import threading
+import time
+from typing import Optional
+
+CRASH_EXIT_CODE = 113  # distinctive: harnesses assert the fault (not a bug) killed us
+
+KINDS = ("crash", "io-error", "delay", "torn-write")
+
+
+class FaultError(OSError):
+    """The io-error kind. An OSError so production except-clauses treat it
+    exactly like a real disk/network failure."""
+
+    def __init__(self, name: str):
+        super().__init__(errno.EIO, f"injected fault at point {name!r}")
+        self.point = name
+
+
+class _Point:
+    __slots__ = ("name", "kind", "arg", "skip", "count", "hits", "fired")
+
+    def __init__(self, name: str, kind: str, arg: Optional[float],
+                 skip: int, count: int):
+        self.name = name
+        self.kind = kind
+        self.arg = arg
+        self.skip = skip
+        self.count = count
+        self.hits = 0  # times fire(name) reached this point
+        self.fired = 0  # times the fault actually triggered
+
+
+_points: dict[str, _Point] = {}
+_hit_log: dict[str, int] = {}
+_lock = threading.Lock()
+
+
+def arm(
+    name: str,
+    kind: str,
+    arg: Optional[float] = None,
+    skip: int = 0,
+    count: int = 1,
+) -> None:
+    if kind not in KINDS:
+        raise ValueError(f"unknown fault kind {kind!r} (want one of {KINDS})")
+    with _lock:
+        _points[name] = _Point(name, kind, arg, skip, count)
+
+
+def disarm(name: str) -> None:
+    with _lock:
+        _points.pop(name, None)
+
+
+def reset() -> None:
+    """Disarm everything and clear hit counters (test teardown)."""
+    with _lock:
+        _points.clear()
+        _hit_log.clear()
+
+
+def active() -> bool:
+    return bool(_points)
+
+
+def hits(name: str) -> int:
+    """How many times an ARMED point's fault actually triggered."""
+    with _lock:
+        p = _points.get(name)
+        return p.fired if p is not None else _hit_log.get(name, 0)
+
+
+def fire(name: str, path: Optional[str] = None) -> None:
+    """Hot-path hook. Disarmed cost: one dict truthiness check."""
+    if not _points:
+        return
+    _fire(name, path)
+
+
+def _fire(name: str, path: Optional[str]) -> None:
+    with _lock:
+        p = _points.get(name)
+        if p is None:
+            return
+        p.hits += 1
+        if p.hits <= p.skip:
+            return
+        if p.count and p.fired >= p.count:
+            return
+        p.fired += 1
+        _hit_log[name] = _hit_log.get(name, 0) + 1
+        kind, arg = p.kind, p.arg
+    try:
+        from . import glog
+
+        glog.info("fault point %s firing: %s", name, kind)
+    except Exception:
+        pass
+    if kind == "delay":
+        time.sleep(arg if arg is not None else 0.05)
+        return
+    if kind == "io-error":
+        raise FaultError(name)
+    if kind == "torn-write" and path is not None:
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(int(size * (arg if arg is not None else 0.5)))
+        except OSError:
+            pass  # the point of torn-write is the crash that follows
+    # crash (and torn-write's power-loss tail): no flushes, no handlers
+    os._exit(CRASH_EXIT_CODE)
+
+
+def _parse_env(spec: str) -> None:
+    """``name=kind[:arg[:skip[:count]]]`` comma-list → arm() calls.
+    Malformed entries raise — a crash harness silently running without its
+    fault would report vacuous green."""
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, rhs = entry.partition("=")
+        if not name or not rhs:
+            raise ValueError(f"bad SWEED_FAULTPOINTS entry {entry!r}")
+        parts = rhs.split(":")
+        kind = parts[0]
+        arg = float(parts[1]) if len(parts) > 1 and parts[1] != "" else None
+        skip = int(parts[2]) if len(parts) > 2 and parts[2] != "" else 0
+        count = int(parts[3]) if len(parts) > 3 and parts[3] != "" else 1
+        arm(name, kind, arg=arg, skip=skip, count=count)
+
+
+_env_spec = os.environ.get("SWEED_FAULTPOINTS", "")
+if _env_spec:
+    _parse_env(_env_spec)

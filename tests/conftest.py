@@ -63,3 +63,9 @@ def pytest_configure(config):
         "soak: full-stack chaos soak (kill-9 + failover under mixed "
         "traffic); opt-in via SWEED_SOAK=1",
     )
+    config.addinivalue_line(
+        "markers",
+        "crash: crash-matrix fault injection (subprocess hard-killed at an "
+        "armed protocol step, restart recovery invariants asserted); the "
+        "fast subset runs in tier-1, the full matrix joins the soak",
+    )

@@ -1,0 +1,357 @@
+"""Fault-point registry semantics + staged-commit recovery scan.
+
+The crash matrix (test_crash_matrix.py) exercises the kinds that kill the
+process; this file covers everything testable in-process: arm/skip/count
+accounting, env-spec parsing, and the restart recovery decisions of
+storage/commit.py over synthesized on-disk states (satellite: partial
+.tmp shard sets, orphaned manifests, half-applied renames).
+"""
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage import commit
+from seaweedfs_tpu.storage.commit import (
+    StagedCommit,
+    atomic_write,
+    pending_commit,
+    recover_directory,
+)
+from seaweedfs_tpu.util import faultpoints
+from seaweedfs_tpu.util.faultpoints import FaultError
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faultpoints.reset()
+    yield
+    faultpoints.reset()
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_disarmed_fire_is_noop():
+    assert not faultpoints.active()
+    faultpoints.fire("anything.at.all")  # must not raise, sleep, or exit
+    assert faultpoints.hits("anything.at.all") == 0
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faultpoints.arm("x", "segfault")
+
+
+def test_io_error_fires_once_by_default():
+    faultpoints.arm("p.io", "io-error")
+    with pytest.raises(FaultError) as ei:
+        faultpoints.fire("p.io")
+    assert ei.value.errno == 5  # EIO: production code treats it as a disk error
+    assert isinstance(ei.value, OSError)
+    faultpoints.fire("p.io")  # count=1 exhausted: passes through
+    assert faultpoints.hits("p.io") == 1
+
+
+def test_skip_and_count():
+    faultpoints.arm("p.skip", "io-error", skip=2, count=2)
+    faultpoints.fire("p.skip")
+    faultpoints.fire("p.skip")  # two skipped hits
+    for _ in range(2):
+        with pytest.raises(FaultError):
+            faultpoints.fire("p.skip")
+    faultpoints.fire("p.skip")  # count exhausted
+    assert faultpoints.hits("p.skip") == 2
+
+
+def test_count_zero_fires_forever():
+    faultpoints.arm("p.inf", "io-error", count=0)
+    for _ in range(5):
+        with pytest.raises(FaultError):
+            faultpoints.fire("p.inf")
+    assert faultpoints.hits("p.inf") == 5
+
+
+def test_delay_kind_sleeps_then_continues():
+    faultpoints.arm("p.delay", "delay", arg=0.001)
+    faultpoints.fire("p.delay")  # returns normally
+    assert faultpoints.hits("p.delay") == 1
+
+
+def test_disarm_and_reset():
+    faultpoints.arm("p.a", "io-error")
+    faultpoints.disarm("p.a")
+    faultpoints.fire("p.a")
+    faultpoints.arm("p.b", "io-error")
+    faultpoints.reset()
+    assert not faultpoints.active()
+    faultpoints.fire("p.b")
+
+
+def test_hit_log_survives_disarm():
+    faultpoints.arm("p.log", "delay", arg=0.0)
+    faultpoints.fire("p.log")
+    faultpoints.disarm("p.log")
+    assert faultpoints.hits("p.log") == 1
+
+
+def test_env_spec_parsing():
+    faultpoints._parse_env("a.b=io-error, c.d=delay:0.2:3:0 ,")
+    assert faultpoints.active()
+    with pytest.raises(FaultError):
+        faultpoints.fire("a.b")
+    p = faultpoints._points["c.d"]
+    assert (p.kind, p.arg, p.skip, p.count) == ("delay", 0.2, 3, 0)
+
+
+@pytest.mark.parametrize(
+    "spec", ["nameonly", "=crash", "x=", "x=notakind", "x=delay:abc"]
+)
+def test_env_spec_malformed_raises(spec):
+    # a harness whose fault silently failed to arm would report vacuous green
+    with pytest.raises(ValueError):
+        faultpoints._parse_env(spec)
+
+
+# -- atomic_write / StagedCommit happy paths ---------------------------------
+
+
+def test_atomic_write_no_tmp_left(tmp_path):
+    p = str(tmp_path / "f.bin")
+    atomic_write(p, b"hello", mode=0o600)
+    with open(p, "rb") as f:
+        assert f.read() == b"hello"
+    assert not os.path.exists(p + ".tmp")
+    atomic_write(p, b"replaced")
+    with open(p, "rb") as f:
+        assert f.read() == b"replaced"
+
+
+def test_staged_commit_full_cycle(tmp_path):
+    base = str(tmp_path / "1")
+    victim = str(tmp_path / "old.tier")
+    with open(victim, "w") as f:
+        f.write("x")
+    sc = StagedCommit(base, "t")
+    for name, data in (("1.ec00", b"a" * 10), ("1.ecx", b"b" * 4)):
+        tmp = sc.stage(str(tmp_path / name))
+        with open(tmp, "wb") as f:
+            f.write(data)
+    sc.remove_on_commit(victim)
+    assert pending_commit(base) is False
+    sc.commit()
+    assert sorted(os.listdir(tmp_path)) == ["1.ec00", "1.ecx"]
+    with open(tmp_path / "1.ec00", "rb") as f:
+        assert f.read() == b"a" * 10
+    assert pending_commit(base) is False
+
+
+def test_staged_commit_abort_drops_staging(tmp_path):
+    base = str(tmp_path / "2")
+    sc = StagedCommit(base, "t")
+    tmp = sc.stage(base + ".dat")
+    with open(tmp, "wb") as f:
+        f.write(b"partial")
+    sc.abort()
+    assert os.listdir(tmp_path) == []
+
+
+def test_staged_commit_custom_tmp_name(tmp_path):
+    # vacuum keeps the reference .cpd/.cpx staging names
+    base = str(tmp_path / "3")
+    sc = StagedCommit(base, "vacuum")
+    tmp = sc.stage(base + ".dat", tmp_path=base + ".cpd")
+    assert tmp == base + ".cpd"
+    with open(tmp, "wb") as f:
+        f.write(b"compacted")
+    sc.commit()
+    assert os.path.exists(base + ".dat")
+    assert not os.path.exists(base + ".cpd")
+
+
+# -- recovery scan over synthesized crash states -----------------------------
+
+
+def _write(path, data=b"x" * 8):
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def test_recover_gc_orphan_staging(tmp_path):
+    """Partial .tmp shard set with no manifest: the encode died before its
+    commit point — every staged file must go, the plain volume is truth."""
+    d = str(tmp_path)
+    _write(os.path.join(d, "1.dat"), b"live")
+    for name in ("1.ec00.tmp", "1.ec07.tmp", "1.ecx.tmp", "1.cpd", "1.cpx"):
+        _write(os.path.join(d, name))
+    actions = recover_directory(d)
+    assert sorted(os.listdir(d)) == ["1.dat"]
+    assert sorted(actions["gc"]) == [
+        "1.cpd", "1.cpx", "1.ec00.tmp", "1.ec07.tmp", "1.ecx.tmp",
+    ]
+    assert actions["rolled_forward"] == [] and actions["rolled_back"] == []
+
+
+def test_recover_rolls_forward_complete_manifest(tmp_path):
+    d = str(tmp_path)
+    _write(os.path.join(d, "1.ec00.tmp"), b"s" * 12)
+    _write(os.path.join(d, "1.ecx.tmp"), b"i" * 6)
+    _write(os.path.join(d, "1.tier"), b"{}")
+    manifest = {
+        "tag": "ec.encode",
+        "files": {
+            "1.ec00": {"tmp": "1.ec00.tmp", "size": 12},
+            "1.ecx": {"tmp": "1.ecx.tmp", "size": 6},
+        },
+        "remove": ["1.tier"],
+    }
+    with open(os.path.join(d, "1.commit"), "w") as f:
+        json.dump(manifest, f)
+    actions = recover_directory(d)
+    assert actions["rolled_forward"] == ["ec.encode:1.commit"]
+    assert sorted(os.listdir(d)) == ["1.ec00", "1.ecx"]
+    with open(os.path.join(d, "1.ec00"), "rb") as f:
+        assert f.read() == b"s" * 12
+
+
+def test_recover_rolls_forward_half_applied_renames(tmp_path):
+    """Crash mid-rename pass: some outputs already final, some staged.
+    os.replace idempotency must finish the pass, not duplicate or drop."""
+    d = str(tmp_path)
+    _write(os.path.join(d, "1.ec00"), b"d" * 9)  # already renamed
+    _write(os.path.join(d, "1.ecx.tmp"), b"i" * 5)  # still staged
+    manifest = {
+        "tag": "ec.encode",
+        "files": {
+            "1.ec00": {"tmp": "1.ec00.tmp", "size": 9},
+            "1.ecx": {"tmp": "1.ecx.tmp", "size": 5},
+        },
+        "remove": [],
+    }
+    with open(os.path.join(d, "1.commit"), "w") as f:
+        json.dump(manifest, f)
+    actions = recover_directory(d)
+    assert actions["rolled_forward"] == ["ec.encode:1.commit"]
+    assert sorted(os.listdir(d)) == ["1.ec00", "1.ecx"]
+
+
+def test_recover_rolls_back_incomplete_manifest(tmp_path):
+    """Manifest present but a staged file is short of its recorded size —
+    the manifest is lying (fs loss); rolling forward would install torn
+    files, so the scan must roll back instead."""
+    d = str(tmp_path)
+    _write(os.path.join(d, "1.dat"), b"old state")
+    _write(os.path.join(d, "1.ec00.tmp"), b"s" * 5)  # size says 12
+    manifest = {
+        "tag": "ec.encode",
+        "files": {"1.ec00": {"tmp": "1.ec00.tmp", "size": 12}},
+        "remove": [],
+    }
+    with open(os.path.join(d, "1.commit"), "w") as f:
+        json.dump(manifest, f)
+    actions = recover_directory(d)
+    assert actions["rolled_back"] == ["ec.encode:1.commit"]
+    assert sorted(os.listdir(d)) == ["1.dat"]
+    with open(os.path.join(d, "1.dat"), "rb") as f:
+        assert f.read() == b"old state"
+
+
+def test_recover_garbage_manifest_removed(tmp_path):
+    """A torn manifest (half-written JSON) never became a commit point —
+    atomic_write makes this unreachable from our own writer, but the scan
+    must still not crash on one (hand-copied dirs, fs corruption)."""
+    d = str(tmp_path)
+    _write(os.path.join(d, "1.dat"), b"live")
+    _write(os.path.join(d, "1.commit"), b'{"files": {"trunc')
+    _write(os.path.join(d, "2.commit"), b'{"files": "not-a-dict"}')
+    actions = recover_directory(d)
+    assert sorted(os.listdir(d)) == ["1.dat"]
+    assert actions["rolled_forward"] == []
+
+
+def test_recover_is_idempotent(tmp_path):
+    d = str(tmp_path)
+    _write(os.path.join(d, "1.ec00.tmp"), b"s" * 3)
+    manifest = {
+        "tag": "t",
+        "files": {"1.ec00": {"tmp": "1.ec00.tmp", "size": 3}},
+        "remove": [],
+    }
+    with open(os.path.join(d, "1.commit"), "w") as f:
+        json.dump(manifest, f)
+    first = recover_directory(d)
+    assert first["rolled_forward"]
+    second = recover_directory(d)
+    assert second == {"rolled_forward": [], "rolled_back": [], "gc": []}
+    assert os.listdir(d) == ["1.ec00"]
+
+
+def test_recover_missing_directory_is_noop():
+    actions = recover_directory("/nonexistent/surely/not")
+    assert actions == {"rolled_forward": [], "rolled_back": [], "gc": []}
+
+
+def test_commit_ext_and_staging_suffix_are_scanned():
+    # recovery must GC exactly the staging families the writers use
+    assert commit.COMMIT_EXT == ".commit"
+    assert set(commit._ORPHAN_EXTS) == {".tmp", ".cpd", ".cpx"}
+
+
+# -- DiskLocation integration ------------------------------------------------
+
+
+def test_disk_location_recovers_on_first_load(tmp_path):
+    """Startup scan runs before any volume loads: a staged-but-uncommitted
+    encode is GC'd and the plain volume mounts normally."""
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 7)
+    v.write_needle(Needle(cookie=1, id=1, data=b"survives recovery"))
+    v.sync()
+    v.close()
+    for name in ("7.ec00.tmp", "7.ec01.tmp", "7.ecx.tmp"):
+        _write(os.path.join(str(tmp_path), name))
+
+    loc = DiskLocation(str(tmp_path))
+    loc.load_existing_volumes()
+    assert 7 in loc.volumes
+    n = Needle(id=1)
+    loc.find_volume(7).read_needle(n)
+    assert n.data == b"survives recovery"
+    assert not any(f.endswith(".tmp") for f in os.listdir(tmp_path))
+    loc.close()
+
+
+def test_disk_location_refuses_torn_ec_shard_set(tmp_path):
+    """EC mount verifies shard completeness: truncate one shard after a
+    committed encode and the EC volume must not mount (a torn set would
+    serve corrupt reconstructions); the plain volume still serves."""
+    import numpy as np
+
+    from seaweedfs_tpu.ec.constants import shard_ext
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    store = Store([str(tmp_path)], ec_backend="numpy")
+    store.add_volume(3)
+    rng = np.random.default_rng(5)
+    for i in range(1, 9):
+        store.write_volume_needle(
+            3, Needle(cookie=2, id=i, data=rng.bytes(2000 + i))
+        )
+    store.ec_encode_volume(3)
+    base = store.find_volume(3).file_name()
+    store.close()
+
+    with open(base + shard_ext(4), "r+b") as f:
+        f.truncate(os.path.getsize(base + shard_ext(4)) // 2)
+
+    loc = DiskLocation(str(tmp_path))
+    loc.load_existing_volumes()
+    assert 3 not in loc.ec_volumes  # refused, not half-mounted
+    assert 3 in loc.volumes  # plain copy still live
+    loc.close()

@@ -224,3 +224,26 @@ def test_dot_path_segments_refused_on_write(cluster):
     assert st == 201
     st, data = http_bytes("GET", f"http://{filer.url}/b/..x.txt")
     assert (st, data) == (200, b"d")
+
+
+def test_negative_query_ints_fall_back_to_default(cluster):
+    """?limit=-5 used to flow raw into events[:limit], silently dropping
+    the NEWEST entries; negatives now clamp to the default like garbage."""
+    import json
+
+    _, _, filer = cluster
+    assert FilerServer._qint({"limit": "-5"}, "limit", 1000) == 1000
+    assert FilerServer._qint({"limit": "7"}, "limit", 1000) == 7
+    assert FilerServer._qint({"limit": "zz"}, "limit", 42) == 42
+    assert FilerServer._qint({}, "limit", 42) == 42
+    assert FilerServer._qint({"limit": "0"}, "limit", 42) == 0
+
+    # e2e: the newest mutation must survive a negative limit
+    http_bytes("POST", f"http://{filer.url}/neg/sentinel.txt", b"x")
+    st, body = http_bytes("GET", f"http://{filer.url}/_meta/events?limit=-1")
+    assert st == 200
+    events = json.loads(body)["events"]
+    assert any(
+        (e.get("new_entry") or {}).get("full_path") == "/neg/sentinel.txt"
+        for e in events
+    ), "negative limit dropped the newest event"

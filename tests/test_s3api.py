@@ -672,3 +672,83 @@ def test_presigned_future_dated_rejected():
         headers, b"",
     )
     assert err == ERR_REQUEST_NOT_READY and ident is None
+
+
+def test_strict_query_int_rejects_lenient_python_forms(s3, client):
+    """int() accepts '+5', ' 5 ', and '1_0'; AWS doesn't. The shared
+    strict parser must 400 those for max-keys and partNumber instead of
+    silently honoring a value no other S3 implementation would."""
+    client.create_bucket("strict")
+    client.put_object("strict", "a.txt", b"1")
+    for bad in ("+5", " 5 ", "1_0", "٥"):  # arabic-indic five: isdigit-true
+        status, body, _ = client.list_objects("strict", **{"max-keys": bad})
+        assert status == 400 and b"InvalidArgument" in body, (bad, status)
+    # plain digits keep working
+    status, body, _ = client.list_objects("strict", **{"max-keys": "1"})
+    assert status == 200
+
+    status, body, _ = client.request("POST", "/strict/mp", query={"uploads": ""})
+    upload_id = find_text(parse_xml(body), "UploadId")
+    for bad in ("+1", " 1", "1_0"):
+        status, body, _ = client.request(
+            "PUT", "/strict/mp",
+            query={"partNumber": bad, "uploadId": upload_id}, body=b"d",
+        )
+        assert status == 400 and b"InvalidArgument" in body, (bad, status)
+
+
+def test_streaming_malformed_scope_is_auth_error_not_incomplete_body(s3):
+    """A credential scope that doesn't unpack into date/region/service/
+    aws4_request used to raise inside the framing decode and surface as
+    IncompleteBody (or a 500); it's an Authorization-header problem."""
+    headers = {
+        "X-Amz-Content-Sha256": "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+        "Authorization": (
+            "AWS4-HMAC-SHA256 Credential=AKIAADMIN/not-a-scope,"
+            "SignedHeaders=host, Signature=00"
+        ),
+        "X-Amz-Date": "20260101T000000Z",
+    }
+    body, err = s3._decode_chunked(headers, b"0;chunk-signature=00\r\n\r\n", "k")
+    assert body is None and err is not None
+    status, xml = err[0], err[1]
+    assert status == 400
+    assert b"AuthorizationHeaderMalformed" in xml
+    assert b"IncompleteBody" not in xml
+
+
+def test_complete_multipart_finds_legacy_04d_part_names(s3, client):
+    """Uploads initiated before the 04d→05d part-name field-width upgrade
+    stored '0001.part'; completing them after the upgrade must still find
+    those parts — and purge them, not leak their chunks."""
+    from seaweedfs_tpu.s3api.s3api_server import UPLOADS_DIR
+
+    client.create_bucket("legacy")
+    status, body, _ = client.request("POST", "/legacy/old.bin", query={"uploads": ""})
+    upload_id = find_text(parse_xml(body), "UploadId")
+    # part 1 uploaded by a current node (05d), part 2 by a legacy node:
+    # upload normally, then rename the entry to the legacy 04d name
+    for num, data in ((1, b"P" * 700), (2, b"Q" * 300)):
+        status, _, _ = client.request(
+            "PUT", "/legacy/old.bin",
+            query={"partNumber": str(num), "uploadId": upload_id}, body=data,
+        )
+        assert status == 200
+    fc = s3.client
+    entry = fc.get_entry(f"{UPLOADS_DIR}/{upload_id}/00002.part")
+    assert entry is not None
+    fc.create_entry(f"{UPLOADS_DIR}/{upload_id}/0002.part", entry)
+    fc.delete(f"{UPLOADS_DIR}/{upload_id}/00002.part", skip_chunk_purge=True)
+
+    parts_xml = "".join(
+        f"<Part><PartNumber>{n}</PartNumber><ETag>x</ETag></Part>" for n in (1, 2)
+    )
+    status, body, _ = client.request(
+        "POST", "/legacy/old.bin", query={"uploadId": upload_id},
+        body=f"<CompleteMultipartUpload>{parts_xml}</CompleteMultipartUpload>".encode(),
+    )
+    assert status == 200, body
+    status, data, _ = client.get_object("legacy", "old.bin")
+    assert status == 200 and data == b"P" * 700 + b"Q" * 300
+    # the legacy-named part's meta is purged with the upload dir
+    assert fc.get_entry(f"{UPLOADS_DIR}/{upload_id}/0002.part") is None
