@@ -20,6 +20,8 @@ import os
 import sys
 import time
 
+from .util.parsers import tolerant_uint
+
 
 def _security_conf():
     """security.toml (weed/util/config.go + security.toml scaffold)."""
@@ -1071,7 +1073,7 @@ def cmd_volume_tail(args):
             raise SystemExit(f"tail {src}: HTTP {status}")
         if blob:
             idle_start = _time.monotonic()
-            version = int(headers.get("X-Volume-Version", "3"))
+            version = tolerant_uint(headers.get("X-Volume-Version", "3"), 3)
             for n in parse_tail_frames(blob, version):
                 mark = "-" if n.size <= 0 else "+"
                 print(
@@ -1083,13 +1085,13 @@ def cmd_volume_tail(args):
                     if n.is_compressed:
                         try:
                             data = compression.ungzip_data(data)
-                        except Exception:  # noqa: BLE001 — display only
+                        except Exception:  # sweedlint: ok broad-except display-only CLI tail; a bad gzip body just isn't printed
                             continue
                     try:
                         print(data.decode("utf-8"))
                     except UnicodeDecodeError:
                         pass
-            since = int(headers.get("X-Last-Append-Ns", since))
+            since = tolerant_uint(headers.get("X-Last-Append-Ns", since), since)
         else:
             if args.timeout_seconds and (
                 _time.monotonic() - idle_start > args.timeout_seconds

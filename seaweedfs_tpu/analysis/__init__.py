@@ -1,0 +1,153 @@
+"""sweedlint — project-specific static analysis for seaweedfs_tpu.
+
+Every rule encodes a bug class this repo has actually shipped (see
+docs/ANALYSIS.md for the history behind each one):
+
+- ``lock-discipline`` — attributes written under ``with self._lock`` must
+  not be touched outside it (lightweight race detector).
+- ``durability``     — renames/unlinks of volume/shard/index files must
+  flow through the StagedCommit protocol in ``storage/commit.py``.
+- ``strict-int``     — ``int()``/``float()`` on request/query/header
+  values must use the shared strict parsers in ``util/parsers.py``.
+- ``broad-except``   — ``except Exception`` must not swallow silently or
+  span auth/context construction.
+- ``resource-leak``  — ``open()`` handles need ``with``, a tracked
+  ``.close()``, or an ownership transfer the code can show.
+
+Run it as ``python -m seaweedfs_tpu.analysis``.  A finding is waived with
+an inline comment on the offending line (or the line above)::
+
+    # sweedlint: ok <rule> <reason>
+
+The reason is mandatory: a suppression with no reason does not count and
+the violation stands, so every waiver in the tree is self-documenting.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+__all__ = [
+    "Violation",
+    "RULES",
+    "analyze_file",
+    "analyze_paths",
+    "baseline_diff",
+    "load_baseline",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*sweedlint:\s*ok\s+(?P<rule>[a-z][a-z-]*)\s+(?P<reason>\S.*)"
+)
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Stable identity used by the baseline file."""
+        return f"{self.rule} {self.path}:{self.line}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _suppressed_lines(src_lines: list[str]) -> dict[int, set[str]]:
+    """1-based line → rules waived there.  A suppression comment covers its
+    own line and the line below it, so both inline and comment-above
+    placement work.  ``# sweedlint: ok`` without a rule+reason matches
+    nothing — the violation stands."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(src_lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rule = m.group("rule")
+        out.setdefault(i, set()).add(rule)
+        out.setdefault(i + 1, set()).add(rule)
+    return out
+
+
+def analyze_file(path: str, relpath: Optional[str] = None) -> list[Violation]:
+    """All un-suppressed violations in one source file."""
+    from . import rules as _rules
+
+    rel = (relpath or path).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Violation("parse-error", rel, e.lineno or 0, str(e.msg))]
+    src_lines = src.splitlines()
+    waived = _suppressed_lines(src_lines)
+    found: list[Violation] = []
+    for rule in _rules.RULES:
+        if not rule.applies_to(rel):
+            continue
+        found.extend(rule.check(tree, rel))
+    return sorted(
+        (v for v in found if v.rule not in waived.get(v.line, ())),
+        key=lambda v: (v.line, v.rule),
+    )
+
+
+def _iter_py_files(root: str) -> Iterable[tuple[str, str]]:
+    if os.path.isfile(root):
+        yield root, os.path.basename(root)
+        return
+    base = os.path.dirname(os.path.abspath(root))
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in ("__pycache__", ".git")
+        )
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                full = os.path.join(dirpath, name)
+                yield full, os.path.relpath(full, base)
+
+
+def analyze_paths(paths: Iterable[str]) -> list[Violation]:
+    found: list[Violation] = []
+    for root in paths:
+        for full, rel in _iter_py_files(root):
+            found.extend(analyze_file(full, rel))
+    return sorted(found, key=lambda v: (v.path, v.line, v.rule))
+
+
+# -- baseline -----------------------------------------------------------------
+# The baseline is a checked-in JSON list of violation keys that are known
+# and tolerated.  The tier-1 gate fails on any violation NOT in the
+# baseline (a regression) and on any baseline entry that no longer fires
+# (a stale waiver) — so the baseline can only shrink over time.
+
+
+def load_baseline(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, list) or not all(
+        isinstance(e, str) for e in data
+    ):
+        raise ValueError(f"baseline {path!r} must be a JSON list of strings")
+    return data
+
+
+def baseline_diff(
+    violations: list[Violation], baseline: list[str]
+) -> tuple[list[Violation], list[str]]:
+    """→ (new violations not in the baseline, stale baseline entries)."""
+    have = {v.key for v in violations}
+    allowed = set(baseline)
+    new = [v for v in violations if v.key not in allowed]
+    stale = sorted(allowed - have)
+    return new, stale

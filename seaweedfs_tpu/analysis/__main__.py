@@ -1,0 +1,76 @@
+"""CLI: ``python -m seaweedfs_tpu.analysis [paths...] [options]``.
+
+Exit status 0 = clean (no violations beyond the baseline, no stale
+baseline entries), 1 = findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import analyze_paths, baseline_diff, load_baseline
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m seaweedfs_tpu.analysis",
+        description="sweedlint: project-specific static analysis",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to scan (default: the "
+        "seaweedfs_tpu package itself)",
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="JSON list of tolerated violation keys; new violations and "
+        "stale entries both fail",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    p.add_argument(
+        "--keys",
+        action="store_true",
+        help="print violation keys only (paste into a baseline file)",
+    )
+    args = p.parse_args(argv)
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(__file__))]
+    violations = analyze_paths(paths)
+    baseline = load_baseline(args.baseline) if args.baseline else []
+    new, stale = baseline_diff(violations, baseline)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "violations": [v.__dict__ for v in new],
+                    "stale_baseline": stale,
+                },
+                indent=1,
+            )
+        )
+    elif args.keys:
+        for v in new:
+            print(v.key)
+    else:
+        for v in new:
+            print(v)
+        for key in stale:
+            print(f"stale baseline entry (no longer fires): {key}")
+        n, s = len(new), len(stale)
+        if n or s:
+            print(f"sweedlint: {n} violation(s), {s} stale baseline entr(ies)")
+        else:
+            print(f"sweedlint: clean ({len(violations)} baselined)")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

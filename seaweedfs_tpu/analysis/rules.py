@@ -1,0 +1,514 @@
+"""The five sweedlint rules.
+
+Each rule is a singleton object with:
+
+- ``name``       — rule id used in findings and suppression comments;
+- ``applies_to(relpath)`` — scope filter (some rules only patrol the
+  layers where their bug class lives);
+- ``check(tree, relpath)`` — AST pass returning raw findings
+  (suppressions are applied by the caller).
+
+Adding a rule: write the class, append an instance to ``RULES``, add a
+fixture pair under ``tests/fixtures/sweedlint/`` and a section in
+``docs/ANALYSIS.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from . import Violation
+
+# -- shared helpers -----------------------------------------------------------
+
+#: dict-like locals/attributes whose values come off the wire (query
+#: strings, parsed headers, request dicts).  int()/float() on anything
+#: derived from these is the strict-int bug class.
+_REQUESTISH = frozenset(
+    {
+        "q",
+        "qs",
+        "query",
+        "req",
+        "request",
+        "params",
+        "form",
+        "headers",
+        "header",
+        "hdrs",
+        "args",
+    }
+)
+
+_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """'q' for Name q, 'headers' for self.headers / h.headers chains."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _func_name(call: ast.Call) -> str:
+    return _terminal_name(call.func) or ""
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    """'_lock' for ``self._lock``; None for anything else."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+# -- rule 1: lock-discipline --------------------------------------------------
+
+
+class LockDiscipline:
+    """Infer which ``self._*`` attributes a class guards with
+    ``with self.<lock>:`` and flag touches of those attributes outside the
+    lock.
+
+    Guard inference: an attribute is *guarded by* lock L when any method
+    other than ``__init__`` writes it inside ``with self.L:``.  Every
+    read or write of a guarded attribute outside L (again excluding
+    ``__init__``, which runs before the object is shared) is a finding.
+
+    Convention hooks the checker understands:
+    - methods whose name contains ``_locked`` are assumed to be called
+      with every class lock already held (document that in the method's
+      docstring) — accesses inside them are treated as guarded;
+    - functions nested inside a method (thread targets, callbacks) run
+      later, so locks held at definition time are NOT considered held
+      inside them.
+    """
+
+    name = "lock-discipline"
+
+    _SCOPES = ("server/", "cluster/", "storage/", "messaging/")
+
+    def applies_to(self, relpath: str) -> bool:
+        return any(s in relpath for s in self._SCOPES)
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(node, relpath))
+        return out
+
+    # one access record: (attr, lineno, is_write, frozenset of held locks)
+    def _check_class(
+        self, cls: ast.ClassDef, relpath: str
+    ) -> list[Violation]:
+        lock_attrs = self._find_lock_attrs(cls)
+        if not lock_attrs:
+            return []
+        accesses: list[tuple[str, int, bool, frozenset]] = []
+        for item in cls.body:
+            if not isinstance(
+                item, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if item.name in ("__init__", "__new__"):
+                continue
+            assume_all = "_locked" in item.name
+            held0 = frozenset(lock_attrs) if assume_all else frozenset()
+            self._walk(item.body, held0, lock_attrs, accesses)
+        guards: dict[str, set[str]] = {}
+        for attr, _line, is_write, held in accesses:
+            if is_write:
+                for lock in held:
+                    guards.setdefault(attr, set()).add(lock)
+        out = []
+        seen: set[tuple[str, int]] = set()
+        for attr, line, _is_write, held in accesses:
+            locks = guards.get(attr)
+            if not locks or locks & held:
+                continue
+            if (attr, line) in seen:
+                continue
+            seen.add((attr, line))
+            lock = sorted(locks)[0]
+            out.append(
+                Violation(
+                    self.name,
+                    relpath,
+                    line,
+                    f"self.{attr} is written under self.{lock} elsewhere "
+                    f"in {cls.name} but touched here without it",
+                )
+            )
+        return out
+
+    @staticmethod
+    def _find_lock_attrs(cls: ast.ClassDef) -> set[str]:
+        locks: set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = node.value
+            if not (
+                isinstance(call, ast.Call)
+                and _func_name(call) in _LOCK_FACTORIES
+            ):
+                continue
+            for tgt in node.targets:
+                attr = _is_self_attr(tgt)
+                if attr:
+                    locks.add(attr)
+        return locks
+
+    def _walk(self, body, held, lock_attrs, accesses) -> None:
+        for stmt in body:
+            self._visit(stmt, held, lock_attrs, accesses)
+
+    def _visit(self, node, held, lock_attrs, accesses) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later (thread target); locks held NOW are
+            # not held THEN
+            self._walk(node.body, frozenset(), lock_attrs, accesses)
+            return
+        if isinstance(node, ast.Lambda):
+            self._visit(node.body, frozenset(), lock_attrs, accesses)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in node.items:
+                attr = _is_self_attr(item.context_expr)
+                if attr in lock_attrs:
+                    acquired.add(attr)
+                else:
+                    self._visit(
+                        item.context_expr, held, lock_attrs, accesses
+                    )
+            self._walk(node.body, held | acquired, lock_attrs, accesses)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _is_self_attr(node)
+            if attr and attr not in lock_attrs:
+                is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+                accesses.append((attr, node.lineno, is_write, held))
+            self._visit(node.value, held, lock_attrs, accesses)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, lock_attrs, accesses)
+
+
+# -- rule 2: durability -------------------------------------------------------
+
+
+class Durability:
+    """In the volume-data layers (``storage/``, ``ec/``) every
+    ``os.rename`` / ``os.replace`` / ``os.unlink`` / ``os.remove``
+    touches a volume, shard, or index file — exactly the renames whose
+    crash-atomicity PR 1 moved into the StagedCommit protocol.  New state
+    transitions must go through ``storage/commit.py`` (or carry a
+    suppression explaining why a raw rename cannot tear)."""
+
+    name = "durability"
+
+    _SCOPES = ("storage/", "ec/")
+    _EXEMPT = ("storage/commit.py",)  # the protocol implementation itself
+    _CALLS = frozenset({"rename", "replace", "unlink", "remove"})
+
+    def applies_to(self, relpath: str) -> bool:
+        if any(relpath.endswith(e) for e in self._EXEMPT):
+            return False
+        return any(s in relpath for s in self._SCOPES)
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in self._CALLS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "os"
+            ):
+                out.append(
+                    Violation(
+                        self.name,
+                        relpath,
+                        node.lineno,
+                        f"os.{f.attr} on a volume-layer path outside "
+                        "StagedCommit (storage/commit.py); a crash here "
+                        "can tear the volume state",
+                    )
+                )
+        return out
+
+
+# -- rule 3: strict-int -------------------------------------------------------
+
+
+class StrictInt:
+    """Bare ``int()`` / ``float()`` on values pulled from request-shaped
+    dicts (query params, headers, request bodies).  Plain ``int()``
+    accepts ``'+5'``, ``' 5 '``, ``'1_0'`` and unicode digits — inputs
+    AWS-compatible endpoints must reject and tolerant endpoints must
+    clamp.  Use ``util.parsers.parse_ascii_uint`` (strict, raises) or
+    ``util.parsers.tolerant_uint`` (falls back to a default) instead."""
+
+    name = "strict-int"
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("int", "float")
+                and len(node.args) == 1  # int(s, 16) is hex framing, not
+                and not node.keywords  # a decimal query int
+            ):
+                continue
+            src = self._request_source(node.args[0])
+            if src:
+                out.append(
+                    Violation(
+                        self.name,
+                        relpath,
+                        node.lineno,
+                        f"bare {node.func.id}() on request-derived value "
+                        f"({src}); use util.parsers.parse_ascii_uint / "
+                        "tolerant_uint",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _request_source(expr: ast.AST) -> Optional[str]:
+        """A description of the request-ish derivation inside ``expr``
+        (``q.get(...)``, ``query[...]``), or None."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "get"
+                    and _terminal_name(f.value) in _REQUESTISH
+                ):
+                    return f"{_terminal_name(f.value)}.get(...)"
+            if isinstance(node, ast.Subscript):
+                base = _terminal_name(node.value)
+                if base in _REQUESTISH:
+                    return f"{base}[...]"
+        return None
+
+
+# -- rule 4: broad-except -----------------------------------------------------
+
+
+class BroadExcept:
+    """Two shapes of the over-broad ``except`` bug class:
+
+    - **silent swallow** — ``except Exception:`` (or bare ``except:``)
+      whose body is only ``pass`` / ``continue``: the failure vanishes
+      with no log line and no error path;
+    - **auth span** — ``except Exception`` / ``except ValueError`` whose
+      ``try`` body includes auth/context construction: an auth failure
+      raised inside gets relabeled as whatever error the handler was
+      written for (the streaming-scope bug PR 1 fixed).
+    """
+
+    name = "broad-except"
+
+    _BROAD = frozenset({"Exception", "BaseException"})
+    _AUTH_MARKERS = ("auth", "streaming_context", "signing_key")
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            try_calls_auth = self._spans_auth(node.body)
+            for handler in node.handlers:
+                types = self._handler_types(handler)
+                broad = not types or types & self._BROAD
+                if broad and self._is_silent(handler.body):
+                    out.append(
+                        Violation(
+                            self.name,
+                            relpath,
+                            handler.lineno,
+                            "except Exception swallows silently (body is "
+                            "only pass/continue); log it or narrow the "
+                            "exception type",
+                        )
+                    )
+                elif try_calls_auth and (
+                    broad or "ValueError" in types
+                ):
+                    out.append(
+                        Violation(
+                            self.name,
+                            relpath,
+                            handler.lineno,
+                            "broad except spans auth/context construction"
+                            " in its try body; an auth failure would be "
+                            "mislabeled as this handler's error",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _handler_types(handler: ast.ExceptHandler) -> set[str]:
+        t = handler.type
+        if t is None:
+            return set()
+        nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+        return {n for n in (_terminal_name(e) for e in nodes) if n}
+
+    @staticmethod
+    def _is_silent(body: list[ast.stmt]) -> bool:
+        return all(
+            isinstance(s, (ast.Pass, ast.Continue)) for s in body
+        )
+
+    def _spans_auth(self, body: list[ast.stmt]) -> bool:
+        """True when the try body mixes auth/context construction with
+        other work.  A try whose ONLY call is the auth construction is the
+        sanctioned narrow shape (the PR 1 fix) and is not flagged."""
+        auth_calls = other_calls = 0
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    fn = _func_name(node).lower()
+                    if any(m in fn for m in self._AUTH_MARKERS):
+                        auth_calls += 1
+                    else:
+                        other_calls += 1
+        return auth_calls > 0 and (other_calls > 0 or len(body) > 1)
+
+
+# -- rule 5: resource-leak ----------------------------------------------------
+
+
+class ResourceLeak:
+    """``open()`` bound to a name with no visible close path.
+
+    Accepted shapes: ``with open(...) as f``; a local ``f = open(...)``
+    whose enclosing function also calls ``f.close()`` (finally blocks and
+    error paths count); ``self._f = open(...)`` in a class that somewhere
+    calls ``self._f.close()`` (the long-lived daemon-handle pattern).
+    Anything else leaks the fd on the error path at best."""
+
+    name = "resource-leak"
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out: list[Violation] = []
+        # enclosing scope for locals = nearest function; for self attrs =
+        # nearest class
+        self._scan(tree, tree, None, out, relpath)
+        return out
+
+    def _scan(self, node, func_scope, class_scope, out, relpath) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._scan(child, func_scope, child, out, relpath)
+            elif isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self._scan(child, child, class_scope, out, relpath)
+            else:
+                if isinstance(child, ast.Assign):
+                    self._check_assign(
+                        child, func_scope, class_scope, out, relpath
+                    )
+                self._scan(child, func_scope, class_scope, out, relpath)
+
+    def _check_assign(
+        self, node: ast.Assign, func_scope, class_scope, out, relpath
+    ) -> None:
+        v = node.value
+        if not (
+            isinstance(v, ast.Call)
+            and isinstance(v.func, ast.Name)
+            and v.func.id == "open"
+        ):
+            return
+        if len(node.targets) != 1:
+            return
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name):
+            if not self._closes_name(func_scope, tgt.id):
+                out.append(
+                    Violation(
+                        self.name,
+                        relpath,
+                        node.lineno,
+                        f"open() bound to {tgt.id!r} with no .close() in "
+                        "the enclosing function; use `with` or close on "
+                        "every path",
+                    )
+                )
+        else:
+            attr = _is_self_attr(tgt)
+            if attr is not None:
+                scope = class_scope or func_scope
+                if not self._closes_self_attr(scope, attr):
+                    out.append(
+                        Violation(
+                            self.name,
+                            relpath,
+                            node.lineno,
+                            f"open() bound to self.{attr} but no "
+                            f"self.{attr}.close() anywhere in the class; "
+                            "register a close for the daemon lifecycle",
+                        )
+                    )
+
+    @staticmethod
+    def _closes_name(scope, name: str) -> bool:
+        for node in ast.walk(scope):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "close"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == name
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _closes_self_attr(scope, attr: str) -> bool:
+        for node in ast.walk(scope):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "close"
+                and _is_self_attr(node.func.value) == attr
+            ):
+                return True
+        return False
+
+
+RULES = [
+    LockDiscipline(),
+    Durability(),
+    StrictInt(),
+    BroadExcept(),
+    ResourceLeak(),
+]

@@ -102,10 +102,11 @@ class LeaderElection:
         """True only while leadership is quorum-backed: an isolated leader
         whose beats stopped reaching a majority reports False (and the
         master refuses assigns) even before it formally steps down."""
-        if self.leader != self.self_url:
+        if self.leader != self.self_url:  # sweedlint: ok lock-discipline lock-free probe; a stale read flips on the next beat round
             return False
         if len(self.peers) == 1:
             return True
+        # sweedlint: ok lock-discipline staleness is exactly what the lease check bounds
         return (time.time() - self._last_quorum) < self.lease_seconds
 
     # -- vote intake ---------------------------------------------------------
@@ -117,6 +118,7 @@ class LeaderElection:
             return
         tmp = self.state_path + ".tmp"
         with open(tmp, "w") as f:
+            # sweedlint: ok lock-discipline called with self._lock held (see docstring)
             json.dump({"term": self.term, "voted_for": self.voted_for}, f)
             f.flush()
             os.fsync(f.fileno())
@@ -215,9 +217,10 @@ class LeaderElection:
         if len(self.peers) == 1:
             # single master: it IS the cluster — lead immediately, no loop
             # latency (the reference's one-node raft elects itself at boot)
-            self.term = 1
-            self.leader = self.self_url
-            self._last_beat = time.time()
+            with self._lock:
+                self.term = 1
+                self.leader = self.self_url
+                self._last_beat = time.time()
             self.on_leader_change(self.self_url)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -238,7 +241,7 @@ class LeaderElection:
         inline when a peer reports a higher term."""
         body = {
             "leader": self.self_url,
-            "term": self.term,
+            "term": self.term,  # sweedlint: ok lock-discipline stale term in a beat is rejected by peers and triggers step-down
             "max_file_key": self.get_max_file_key(),
             "max_volume_id": self.get_max_volume_id(),
         }
@@ -248,16 +251,18 @@ class LeaderElection:
                 continue
             try:
                 r = self._rpc(p, "/cluster/leader_beat", body)
-            except Exception:
+            except Exception as e:
+                glog.V(2).info("leader_beat to %s failed: %s", p, e)
                 continue
             if r.get("ok"):
                 acks += 1
-            elif r.get("term", 0) > self.term:
+            elif r.get("term", 0) > self.term:  # sweedlint: ok lock-discipline optimistic check; re-validated under the lock below
                 with self._lock:
-                    self.term = r["term"]
-                    self.leader = None
-                    self.voted_for = None
-                    self._persist()
+                    if r["term"] > self.term:
+                        self.term = r["term"]
+                        self.leader = None
+                        self.voted_for = None
+                        self._persist()
                 glog.info("%s: peer %s has term %d, stepping down",
                           self.self_url, p, r["term"])
                 return 0
@@ -278,7 +283,8 @@ class LeaderElection:
                 continue
             try:
                 r = self._rpc(p, "/cluster/vote", body)
-            except Exception:
+            except Exception as e:
+                glog.V(2).info("vote rpc to %s failed: %s", p, e)
                 continue
             if r.get("granted"):
                 votes += 1
@@ -296,7 +302,7 @@ class LeaderElection:
     def _campaign(self) -> None:
         """Pre-vote then real vote for term+1; lead only on a
         configured-set majority."""
-        proposed = self.term + 1
+        proposed = self.term + 1  # sweedlint: ok lock-discipline optimistic; re-validated under the lock before adopting
         pre = self._collect_votes(proposed, prevote=True)
         if pre is None or pre < self.quorum:
             glog.V(1).info("%s: pre-vote for term %d got %s/%d",
@@ -336,13 +342,16 @@ class LeaderElection:
     def _loop(self) -> None:
         interval = self.lease_seconds / 3.0
         while not self._stop.wait(interval):
-            if self.leader == self.self_url:
+            with self._lock:
+                leading = self.leader == self.self_url
+            if leading:
                 acks = self._send_beats()
                 now = time.time()
                 if acks >= self.quorum:
-                    self._last_quorum = now
                     with self._lock:
+                        self._last_quorum = now
                         self._last_beat = now
+                # sweedlint: ok lock-discipline only this thread writes _last_quorum between beats
                 elif now - self._last_quorum > self.lease_seconds:
                     with self._lock:
                         if self.leader == self.self_url:

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..storage.file_id import FileId
+from ..util import glog
 from ..storage.replica_placement import ReplicaPlacement
 from ..storage.ttl import EMPTY_TTL, read_ttl
 from .sequence import MemorySequencer
@@ -161,7 +162,7 @@ class Master:
             try:
                 fn(event)
             except Exception:
-                pass
+                glog.exception("volume-location subscriber failed")
         with self._loc_cond:
             self._loc_version += 1
             self._loc_log.append((self._loc_version, event))
@@ -316,7 +317,8 @@ class Master:
                     continue
                 try:
                     ratio = max(check_garbage(dn, vid) for dn in locations)
-                except Exception:
+                except Exception as e:
+                    glog.V(2).info("vacuum check vid %s failed: %s", vid, e)
                     continue
                 if ratio < threshold:
                     continue

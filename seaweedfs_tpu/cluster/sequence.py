@@ -74,6 +74,7 @@ class EtcdSequencer:
                 )[0]
             )
             if ok:
+                # sweedlint: ok lock-discipline called with self._lock held by next_file_id/set_max
                 self._next, self._ceiling = max(cur, at_least), want
                 return
 

@@ -274,4 +274,4 @@ def rebuild_ecx_file(base_file_name: str, offset_size: int = OFFSET_SIZE) -> Non
                 )
                 if entry is not None:
                     tombstone_sorted_index_entry(ecx, ecx_off, offset_size)
-    os.remove(ecj_path)
+    os.remove(ecj_path)  # sweedlint: ok durability post-apply cleanup; tombstoning is idempotent, a crash just replays the journal

@@ -11,6 +11,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
+from ..util import glog
 from .entry import Entry, FileChunk
 from .filechunks import compact_file_chunks, minus_chunks
 from .filerstore import FilerStore, MemoryStore, NotFoundError
@@ -49,7 +50,10 @@ class Filer:
                     if c.file_id not in {x.file_id for x in chunks}
                 ]
             except Exception:
-                pass  # fall back: purge at least the listed fids
+                # fall back: purge at least the listed fids; the manifest
+                # chunks themselves become unreferenced garbage, so say so
+                glog.V(1).info("manifest resolve failed; purging %d listed"
+                               " fids only", len(chunks))
         return [c.file_id for c in chunks]
 
     def _ensure_root(self) -> None:

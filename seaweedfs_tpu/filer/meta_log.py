@@ -24,6 +24,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from ..util import glog
+
 _SEG_PREFIX = "seg-"
 _SEG_SUFFIX = ".jsonl"
 
@@ -212,7 +214,7 @@ class MetaLog:
             try:
                 fn(ev)
             except Exception:
-                pass
+                glog.exception("meta-log subscriber failed")
         return ev
 
     def oldest_ts_ns(self) -> int:

@@ -12,6 +12,7 @@ from typing import Optional
 
 from ..filer.client import FilerClient
 from ..server.http_util import JsonHandler, start_server
+from ..util.parsers import tolerant_uint
 from .log_buffer import LogBuffer, decode_messages
 
 TOPICS_ROOT = "/topics"
@@ -189,7 +190,11 @@ class Broker:
             tp = self.topics.get_partition(ns, topic, int(part))
         except KeyError as e:
             return 404, {"error": str(e)}
-        msgs = tp.read(int(q.get("since_ns", 0)), int(q.get("limit", 1000)))
+        # tolerant: a subscriber's garbage ?since_ns= must not 500 the broker
+        msgs = tp.read(
+            tolerant_uint(q.get("since_ns", 0), 0),
+            tolerant_uint(q.get("limit", 1000), 1000),
+        )
         out = [
             {
                 "ts_ns": ts,
@@ -200,7 +205,9 @@ class Broker:
         ]
         return 200, {
             "messages": out,
-            "last_ts_ns": out[-1]["ts_ns"] if out else int(q.get("since_ns", 0)),
+            "last_ts_ns": out[-1]["ts_ns"]
+            if out
+            else tolerant_uint(q.get("since_ns", 0), 0),
         }
 
     # /topics/<ns>/<topic>
@@ -213,7 +220,7 @@ class Broker:
             if q.get("op") == "delete":
                 return 200, self.topics.delete_topic(ns, topic)
             return 200, self.topics.create_topic(
-                ns, topic, int(q.get("partitions", 4))
+                ns, topic, tolerant_uint(q.get("partitions", 4), 4)
             )
         conf = self.topics.topic_conf(ns, topic)
         if conf is None:

@@ -621,5 +621,5 @@ class FuseMount:
         for h in handles.values():
             try:
                 h.close()
-            except Exception:  # noqa: BLE001 — best-effort drain
+            except Exception:  # sweedlint: ok broad-except best-effort handle drain on unmount; nothing to do with a failed close
                 pass

@@ -16,6 +16,7 @@ import time
 from typing import Optional
 
 from ..filer.client import FilerClient
+from ..util import glog
 from ..filer.entry import Entry
 
 
@@ -61,7 +62,8 @@ class MetaCache:
         while not self._stop.wait(poll_seconds):
             try:
                 r = self.client.meta_events(since_ns=self._last_ts_ns)
-            except Exception:
+            except Exception as e:
+                glog.V(2).info("meta_events poll failed: %s", e)
                 continue
             for e in r.get("events", ()):
                 self._apply(e)

@@ -17,6 +17,7 @@ import time
 from typing import Optional
 
 from ..filer.client import FilerClient
+from ..util import glog
 from .wfs import WFS
 
 
@@ -175,7 +176,8 @@ class MountSync:
         while not self._stop.wait(self.scan_seconds):
             try:
                 self.sync_once()
-            except Exception:
+            except Exception as e:
+                glog.V(1).info("sync pass failed: %s", e)
                 continue
 
     def sync_once(self) -> dict:
@@ -201,8 +203,8 @@ class MountSync:
             # wedge the feed: apply best-effort, always advance past it
             try:
                 applied += self._apply_one_remote_event(e)
-            except Exception:
-                pass
+            except Exception as e:
+                glog.V(2).info("remote event skipped: %s", e)
         self._last_ts_ns = r.get("last_ts_ns", self._last_ts_ns)
         return applied
 

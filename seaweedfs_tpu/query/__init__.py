@@ -2,6 +2,7 @@
 volume_grpc_query.go:12` + `weed/query/json`): server-side filtering and
 projection of CSV / JSON-lines object content."""
 
+from ..util.parsers import tolerant_uint
 from .engine import run_query  # noqa: F401
 from .sql import parse_sql, run_sql  # noqa: F401
 
@@ -27,6 +28,9 @@ def execute_request(data: bytes, req: dict) -> tuple[int, dict]:
             input_format=req.get("input", "json"),
             select=req.get("select"),
             where=req.get("where"),
-            limit=int(req.get("limit", 0)),
+            # strict ascii-digit parse with negative/garbage clamped to
+            # the unlimited default — '+5', ' 5 ' and '-5' must not pick
+            # rows by accident (and ?limit=-5 would slice from the tail)
+            limit=tolerant_uint(req.get("limit", 0), 0),
         )
     return 200, {"rows": rows, "count": len(rows)}

@@ -14,6 +14,7 @@ import threading
 import time
 
 from ..filer.client import FilerClient
+from ..util import glog
 from .replicator import Replicator
 from .sink import FilerSink
 
@@ -72,7 +73,9 @@ class FilerSync:
             try:
                 self.replicator.replicate(ev)
             except Exception:
-                pass  # keep the stream moving; next full-sync repairs
+                # keep the stream moving; the next full-sync repairs it
+                glog.exception("replicate event at ts %s failed",
+                               ev.get("ts_ns"))
             self._set_offset(ev["ts_ns"])
         return len(events)
 

@@ -25,6 +25,8 @@ from dataclasses import dataclass, field
 from datetime import datetime, timezone
 from typing import Optional
 
+from ..util.parsers import parse_ascii_uint
+
 ACTION_ADMIN = "Admin"
 ACTION_READ = "Read"
 ACTION_WRITE = "Write"
@@ -41,6 +43,10 @@ ERR_INVALID_ACCESS_KEY = "InvalidAccessKeyId"
 ERR_SIGNATURE_MISMATCH = "SignatureDoesNotMatch"
 ERR_MISSING_FIELDS = "MissingFields"
 ERR_EXPIRED_REQUEST = "ExpiredPresignRequest"
+# malformed presign query values (non-numeric X-Amz-Expires etc.) are the
+# client's error: AWS answers 400 AuthorizationQueryParametersError, and
+# anything else here either coerces ('+5' parsed as 5) or turns into a 500
+ERR_MALFORMED_QUERY = "AuthorizationQueryParametersError"
 # the reference's ErrRequestNotReadyYet serializes as code "AccessDenied"
 # with 403 (s3api_errors.go:317-321) — a URL dated in the future is not
 # "expired", it has not begun its validity window
@@ -245,9 +251,14 @@ class IAM:
             signed_at = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
                 tzinfo=timezone.utc
             )
-            expires = int(query.get("X-Amz-Expires", "604800"))
         except ValueError:
             return None, ERR_MISSING_FIELDS
+        try:
+            # strict ascii-digit parse: plain int() would accept '+5' and
+            # ' 5 ' (values AWS rejects) and still 500 on garbage
+            expires = parse_ascii_uint(query.get("X-Amz-Expires", "604800"))
+        except ValueError:
+            return None, ERR_MALFORMED_QUERY
         if _time.time() > signed_at.timestamp() + expires:
             return None, ERR_EXPIRED_REQUEST
         # a URL "signed" in the future defeats X-Amz-Expires (it would stay
@@ -349,10 +360,14 @@ class IAM:
         if ident is None:
             return None, ERR_INVALID_ACCESS_KEY
         try:
-            if _time.time() > int(query.get("Expires", "0")):
-                return None, ERR_EXPIRED_REQUEST
+            # strict: a V2 presign whose Expires is not a plain epoch
+            # integer is denied (AWS: 403 "Invalid date format"), not
+            # coerced and not a 500
+            expires_at = parse_ascii_uint(query.get("Expires", "0"))
         except ValueError:
-            return None, ERR_MISSING_FIELDS
+            return None, ERR_ACCESS_DENIED
+        if _time.time() > expires_at:
+            return None, ERR_EXPIRED_REQUEST
         sts = "\n".join(
             [method, "", "", query.get("Expires", "")]
         ) + "\n" + path

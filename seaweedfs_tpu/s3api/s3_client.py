@@ -15,6 +15,8 @@ import urllib.request
 from datetime import datetime, timezone
 from typing import Optional
 
+from ..util.parsers import tolerant_uint
+
 
 class S3Client:
     def __init__(
@@ -311,7 +313,7 @@ class S3Client:
                 # don't strand uploaded parts on the backend
                 try:
                     self.abort_multipart(bucket, key, upload_id)
-                except Exception:
+                except Exception:  # sweedlint: ok broad-except best-effort abort; the complete error re-raises below
                     pass
                 raise
 
@@ -322,7 +324,7 @@ class S3Client:
         status, _, headers = self.head_object(bucket, key)
         if status != 200:
             raise RuntimeError(f"head before ranged get: HTTP {status}")
-        size = int(headers.get("Content-Length", 0))
+        size = tolerant_uint(headers.get("Content-Length", 0), 0)
         total = 0
         with open(path, "wb") as f:
             while total < size:

@@ -29,6 +29,7 @@ from ..server.http_util import (
     relay_stream,
     start_server,
 )
+from ..util.parsers import parse_ascii_uint
 from . import auth as s3auth
 from . import policy_engine as pe
 from . import post_policy as pp
@@ -57,18 +58,15 @@ _ERR_STATUS = {
     "BucketNotEmpty": 409,
     "NoSuchBucketPolicy": 404,
     "AuthorizationHeaderMalformed": 400,
+    "AuthorizationQueryParametersError": 400,
     "InternalError": 500,
 }
 
 
 def _parse_s3_int(s: str) -> int:
-    """AWS-strict non-negative query integer: ascii digits only. Plain
-    int() accepts '+5', ' 5 ', '1_0' — values AWS rejects — so every S3
-    query int (max-keys, partNumber) parses through here, matching the
-    strict rule parse_content_length applies to bodies."""
-    if not (s.isascii() and s.isdigit()):
-        raise ValueError(f"not a non-negative integer: {s!r}")
-    return int(s)
+    """AWS-strict non-negative query integer (max-keys, partNumber):
+    the shared ascii-digit parser, kept under its historical local name."""
+    return parse_ascii_uint(s)
 
 
 def _iso(ts: float) -> str:

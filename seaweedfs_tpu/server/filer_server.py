@@ -39,6 +39,7 @@ from ..filer.filechunks import MAX_INT64, view_from_chunks
 from ..filer.filer import Filer
 from ..filer.filerstore import NotFoundError, SqliteStore
 from ..util import glog
+from ..util.parsers import tolerant_ufloat, tolerant_uint
 from ..wdclient import MasterClient
 from .http_util import (
     JsonHandler,
@@ -204,17 +205,11 @@ class FilerServer:
     # -- meta subscribe / kv / status (filer_pb rpc analogs) -----------------
     @staticmethod
     def _qint(q, key, default):
-        """Tolerant query-int: garbage falls back to the default, the way
-        the reference's handlers treat strconv.Atoi failures — a client's
-        bad parameter must not surface as the daemon's 500. Negatives fall
-        back too: every caller is a count/limit/timestamp, and a raw
-        ``?limit=-5`` would slice ``events[:-5]`` and silently drop the
-        NEWEST entries."""
-        try:
-            val = int(q.get(key, default))
-        except ValueError:
-            return default
-        return val if val >= 0 else default
+        """Tolerant query-int: garbage and negatives fall back to the
+        default, the way the reference's handlers treat strconv.Atoi
+        failures — a client's bad parameter must not surface as the
+        daemon's 500 (see util.parsers for the full rationale)."""
+        return tolerant_uint(q.get(key, default), default)
 
     def _h_assign(self, h, path, q, body):
         """AssignVolume rpc analog (pb/filer.proto): mount and other write-
@@ -241,12 +236,9 @@ class FilerServer:
     def _meta_reply(self, log, q):
         since = self._qint(q, "since_ns", 0)
         limit = self._qint(q, "limit", 1000)
-        try:
-            wait_s = min(float(q.get("wait_s", 0)), 30.0)
-        except ValueError:
-            wait_s = 0.0
-        if not wait_s > 0:  # catches negatives AND NaN (nan > 0 is False);
-            wait_s = 0.0    # a NaN deadline busy-loops Condition.wait
+        # tolerant_ufloat clamps garbage, negatives AND NaN to 0 (a NaN
+        # deadline busy-loops Condition.wait)
+        wait_s = min(tolerant_ufloat(q.get("wait_s", 0), 0.0), 30.0)
         events = log.wait_since(since, timeout=wait_s)[:limit]
         out = [e.to_dict() for e in events]
         last = out[-1]["ts_ns"] if out else since

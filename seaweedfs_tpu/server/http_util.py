@@ -36,7 +36,7 @@ def relay_stream(handler, payload, declared_len: Optional[int] = None) -> None:
     finally:
         try:
             payload.close()
-        except Exception:
+        except Exception:  # sweedlint: ok broad-except close of an already-failed upstream body; nothing to report
             pass
     if declared_len is not None and sent != declared_len:
         glog.error("stream relay produced %d of %d bytes", sent, declared_len)
@@ -87,6 +87,12 @@ def drain_refused_body(handler, reader, cap: int = 32 << 20,
         handler.connection.settimeout(old)
     if reader.left > 0:
         handler.close_connection = True
+
+
+class BadRequest(Exception):
+    """Raised by route handlers on a malformed request parameter; the
+    JsonHandler dispatcher answers 400 with the message instead of the
+    generic 500 a stray ValueError would produce."""
 
 
 class StreamBody:
@@ -176,6 +182,12 @@ class JsonHandler(BaseHTTPRequestHandler):
                         if body is None:
                             body = self.rfile.read(length) if length else b""
                         status, payload = fn(self, parsed.path, query, body)
+                except BadRequest as e:
+                    status, payload = 400, {"error": str(e)}
+                    if streaming:
+                        # the request body may be half-consumed; keep-alive
+                        # framing is gone, so drop the connection after reply
+                        self.close_connection = True
                 except Exception as e:
                     glog.exception("%s %s failed", method, parsed.path)
                     status, payload = 500, {"error": f"{type(e).__name__}: {e}"}

@@ -22,6 +22,7 @@ from typing import Optional
 from ..cluster.master import Master
 from ..cluster.topology import DataNode
 from ..util import glog
+from ..util.parsers import tolerant_ufloat, tolerant_uint
 from .http_util import JsonHandler, http_json, start_server
 
 
@@ -131,7 +132,7 @@ class MasterServer:
     # -- handlers ------------------------------------------------------------
     def _h_assign(self, h, path, q, body):
         res = self.master.assign(
-            count=int(q.get("count", 1)),
+            count=tolerant_uint(q.get("count", 1), 1),
             replication=q.get("replication", ""),
             collection=q.get("collection", ""),
             ttl=q.get("ttl", ""),
@@ -163,7 +164,7 @@ class MasterServer:
         return 200, {"volumeId": vid_str, "locations": locations}
 
     def _h_lookup_ec(self, h, path, q, body):
-        vid = int(q.get("volumeId", "0"))
+        vid = tolerant_uint(q.get("volumeId", "0"), 0)
         res = self.master.lookup_ec_volume(vid)
         if not res["shard_id_locations"]:
             return 404, {"error": f"ec volume {vid} not found"}
@@ -209,12 +210,14 @@ class MasterServer:
             ttl=read_ttl(q["ttl"]) if q.get("ttl") else EMPTY_TTL,
             data_center=q.get("dataCenter", ""),
         )
-        count = int(q.get("count", 1))
+        count = tolerant_uint(q.get("count", 1), 1)
         grown = self.master.vg.grow_by_count(self.master.topo, option, count)
         return 200, {"count": grown}
 
     def _h_vacuum(self, h, path, q, body):
-        threshold = float(q.get("garbageThreshold", self.master.garbage_threshold))
+        threshold = tolerant_ufloat(
+            q.get("garbageThreshold", ""), self.master.garbage_threshold
+        )
 
         def check(dn, vid):
             r = http_json("GET", f"http://{dn.url()}/admin/vacuum_check?volume={vid}")
@@ -312,8 +315,8 @@ class MasterServer:
         # KeepConnected analog (master_grpc_server.go:178): long-poll for
         # VolumeLocation deltas past `since`; falls back to a snapshot when
         # the client is too far behind the retained log.
-        since = int(q.get("since", 0))
-        timeout = min(float(q.get("timeout", 10.0)), 30.0)
+        since = tolerant_uint(q.get("since", 0), 0)
+        timeout = min(tolerant_ufloat(q.get("timeout", 10.0), 10.0), 30.0)
         return 200, self.master.location_deltas(since, timeout)
 
     # -- liveness reaping (master_grpc_server.go:22-50 on stream close) ------

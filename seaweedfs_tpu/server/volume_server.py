@@ -42,7 +42,31 @@ from ..storage.needle import (
 from ..storage.store import Store
 from ..storage.volume import DeletedError, NotFoundError, volume_file_name
 from ..util import glog
-from .http_util import JsonHandler, http_bytes, http_json, start_server
+from ..util.parsers import tolerant_uint
+from .http_util import (
+    BadRequest,
+    JsonHandler,
+    http_bytes,
+    http_json,
+    start_server,
+)
+
+
+def _q_req_uint(q: dict, key: str) -> int:
+    """Required non-negative query int (``?volume=``, ``?shard=``): a
+    missing or malformed value is the client's error → 400, where a bare
+    ``int(q[key])`` surfaced it as this daemon's 500."""
+    raw = q.get(key)
+    val = tolerant_uint(raw, None) if raw is not None else None
+    if val is None:
+        raise BadRequest(f"bad {key}={raw!r}: non-negative integer required")
+    return val
+
+
+def _q_uint(q: dict, key: str, default: int) -> int:
+    """Optional non-negative query int: garbage/negatives fall back to the
+    default, matching the reference's ignored-Atoi-failure handlers."""
+    return tolerant_uint(q.get(key, default), default)
 
 
 class VolumeServer:
@@ -178,14 +202,11 @@ class VolumeServer:
                 return self._serve_chunked_manifest(h, n, data)
             def _dim(key):
                 # the reference ignores Atoi failures (resizing.go) —
-                # ?width=zz serves the original bytes, it doesn't fail the
-                # read; the gzip and Range gates below must see the same
-                # parsed view, or an ignored parameter would silently
-                # disable gzip passthrough / 206s
-                try:
-                    return int(q[key]) if q.get(key) else None
-                except ValueError:
-                    return None
+                # ?width=zz (or a negative) serves the original bytes, it
+                # doesn't fail the read; the gzip and Range gates below
+                # must see the same parsed view, or an ignored parameter
+                # would silently disable gzip passthrough / 206s
+                return tolerant_uint(q.get(key), None) or None
 
             width, height = _dim("width"), _dim("height")
             serving_gzip = False
@@ -498,11 +519,11 @@ class VolumeServer:
         """Binary needle stream: frames of [4B len][record bytes] for records
         appended after since_ns (VolumeTailSender). Paged: at most max_bytes
         of frames per response; callers loop until an empty body."""
-        v = self.store.find_volume(int(q["volume"]))
+        v = self.store.find_volume(_q_req_uint(q, "volume"))
         if v is None:
             return 404, {"error": "volume not found"}
-        since = int(q.get("since_ns", 0))
-        max_bytes = int(q.get("max_bytes", 8 * 1024 * 1024))
+        since = _q_uint(q, "since_ns", 0)
+        max_bytes = _q_uint(q, "max_bytes", 8 * 1024 * 1024)
         out = bytearray()
         last_ns = since
         full = False
@@ -526,7 +547,7 @@ class VolumeServer:
     def _h_volume_status(self, h, path, q, body):
         """Per-volume status for backup/copy clients (volume.go FileStat +
         superblock fields)."""
-        v = self.store.find_volume(int(q["volume"]))
+        v = self.store.find_volume(_q_req_uint(q, "volume"))
         if v is None:
             return 404, {"error": "volume not found"}
         return 200, {
@@ -543,11 +564,11 @@ class VolumeServer:
         """Raw .dat bytes from `offset`, at most `max_bytes` per response
         (VolumeIncrementalCopy rpc, volume_grpc_copy_incremental.go). The
         client appends verbatim and rebuilds its index from the new region."""
-        v = self.store.find_volume(int(q["volume"]))
+        v = self.store.find_volume(_q_req_uint(q, "volume"))
         if v is None:
             return 404, {"error": "volume not found"}
-        offset = int(q.get("offset", 0))
-        max_bytes = min(int(q.get("max_bytes", 8 * 1024 * 1024)), 64 * 1024 * 1024)
+        offset = _q_uint(q, "offset", 0)
+        max_bytes = min(_q_uint(q, "max_bytes", 8 * 1024 * 1024), 64 * 1024 * 1024)
         size = v.size()
         n = max(0, min(size - offset, max_bytes))
         data = v.data_backend.read_at(offset, n) if n else b""
@@ -559,7 +580,7 @@ class VolumeServer:
         return 200, data
 
     def _h_tier_upload(self, h, path, q, body):
-        v = self.store.find_volume(int(q["volume"]))
+        v = self.store.find_volume(_q_req_uint(q, "volume"))
         if v is None:
             return 404, {"error": "volume not found"}
         info = v.tier_upload(
@@ -574,7 +595,7 @@ class VolumeServer:
         return 200, info
 
     def _h_tier_download(self, h, path, q, body):
-        v = self.store.find_volume(int(q["volume"]))
+        v = self.store.find_volume(_q_req_uint(q, "volume"))
         if v is None:
             return 404, {"error": "volume not found"}
         v.tier_download(
@@ -584,7 +605,7 @@ class VolumeServer:
 
     # -- admin: volumes ------------------------------------------------------
     def _h_assign_volume(self, h, path, q, body):
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         self.store.add_volume(
             vid,
             collection=q.get("collection", ""),
@@ -649,30 +670,30 @@ class VolumeServer:
         return 200, {"results": results}
 
     def _h_delete_volume(self, h, path, q, body):
-        ok = self.store.delete_volume(int(q["volume"]))
+        ok = self.store.delete_volume(_q_req_uint(q, "volume"))
         return 200, {"deleted": ok}
 
     def _h_readonly(self, h, path, q, body):
-        ok = self.store.mark_volume_readonly(int(q["volume"]))
+        ok = self.store.mark_volume_readonly(_q_req_uint(q, "volume"))
         return (200, {}) if ok else (404, {"error": "volume not found"})
 
     def _h_writable(self, h, path, q, body):
         """VolumeMarkWritable rpc analog (volume_grpc_admin.go) — undo a
         readonly mark so the volume accepts writes again."""
-        ok = self.store.mark_volume_writable(int(q["volume"]))
+        ok = self.store.mark_volume_writable(_q_req_uint(q, "volume"))
         return (200, {}) if ok else (404, {"error": "volume not found"})
 
     def _h_vacuum_check(self, h, path, q, body):
-        v = self.store.find_volume(int(q["volume"]))
+        v = self.store.find_volume(_q_req_uint(q, "volume"))
         if v is None:
             return 404, {"error": "volume not found"}
         return 200, {"garbage_ratio": v.garbage_level()}
 
     def _h_vacuum(self, h, path, q, body):
-        v = self.store.find_volume(int(q["volume"]))
+        v = self.store.find_volume(_q_req_uint(q, "volume"))
         if v is None:
             return 404, {"error": "volume not found"}
-        v.compact(bytes_per_second=int(q.get("compactionBytePerSecond", 0)))
+        v.compact(bytes_per_second=_q_uint(q, "compactionBytePerSecond", 0))
         return 200, {"size": v.size()}
 
     # -- admin: EC (volume_grpc_erasure_coding.go) ---------------------------
@@ -698,7 +719,7 @@ class VolumeServer:
         readonly, stripe to 14 shards with the TPU/CPU codec, write
         .ecx/.vif — staged and committed atomically so a crash mid-encode
         can never leave a half-visible shard set (Store.ec_encode_volume)."""
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         try:
             shards = self.store.ec_encode_volume(vid)
         except NotFoundError:
@@ -706,7 +727,7 @@ class VolumeServer:
         return 200, {"shards": shards}
 
     def _h_ec_rebuild(self, h, path, q, body):
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         base = self._find_base(vid)
         if base is None:
             return 404, {"error": "ec volume not found"}
@@ -719,7 +740,7 @@ class VolumeServer:
     def _h_ec_copy(self, h, path, q, body):
         """Pull shard files (and optionally .ecx/.vif) from a source server
         (VolumeEcShardsCopy, :104)."""
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         source = q["source"]
         shard_ids = [int(s) for s in q.get("shards", "").split(",") if s != ""]
         collection = q.get("collection", "")
@@ -750,7 +771,7 @@ class VolumeServer:
 
     def _h_file(self, h, path, q, body):
         """Serve a raw volume/shard file (CopyFile rpc)."""
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         collection = q.get("collection", "")
         ext = q["ext"]
         if ext in (".dat", ".idx"):
@@ -767,7 +788,7 @@ class VolumeServer:
     def _h_volume_copy(self, h, path, q, body):
         """Pull a whole volume (.dat/.idx) from a source server and load it
         (VolumeCopy rpc, volume_grpc_copy.go)."""
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         source = q["source"]
         collection = q.get("collection", "")
         if self.store.find_volume(vid) is not None:
@@ -796,7 +817,7 @@ class VolumeServer:
     def _h_volume_unmount(self, h, path, q, body):
         """VolumeUnmount: drop the volume from serving, keep its files
         (volume_grpc_admin.go VolumeUnmount)."""
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         if self.store.unmount_volume(vid):
             return 200, {"unmounted": vid}
         return 404, {"error": "volume not found"}
@@ -804,7 +825,7 @@ class VolumeServer:
     def _h_volume_mount(self, h, path, q, body):
         """VolumeMount: (re)load ONE volume from disk and announce it —
         other deliberately-unmounted volumes in the directory stay down."""
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         already = self.store.find_volume(vid) is not None
         v = self.store.mount_volume(vid)
         if v is None:
@@ -817,7 +838,7 @@ class VolumeServer:
         command_volume_configure_replication.go)."""
         from ..storage.replica_placement import ReplicaPlacement
 
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         v = self.store.find_volume(vid)
         if v is None:
             return 404, {"error": "volume not found"}
@@ -861,7 +882,7 @@ class VolumeServer:
         the local shards back into a normal .dat/.idx volume and serve it."""
         from ..ec import decoder as ec_decoder
 
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         base = self._find_base(vid)
         if base is None or not os.path.exists(base + ".ecx"):
             return 404, {"error": f"no local ec volume {vid}"}
@@ -898,7 +919,7 @@ class VolumeServer:
         return 200, {"dat_size": dat_size, "file_count": v.file_count()}
 
     def _h_ec_mount(self, h, path, q, body):
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         for loc in self.store.locations:
             loc.load_existing_volumes()
         ev = self.store.find_ec_volume(vid)
@@ -912,7 +933,7 @@ class VolumeServer:
         return 200, {"shards": sids}
 
     def _h_ec_unmount(self, h, path, q, body):
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         ev = self.store.find_ec_volume(vid)
         bits = sum(1 << s for s in ev.shard_ids()) if ev else 0
         for loc in self.store.locations:
@@ -924,7 +945,7 @@ class VolumeServer:
         return 200, {}
 
     def _h_ec_delete_shards(self, h, path, q, body):
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         shard_ids = [int(s) for s in q.get("shards", "").split(",") if s != ""]
         base = self._find_base(vid)
         removed = []
@@ -961,9 +982,9 @@ class VolumeServer:
         return 200, {"removed": removed}
 
     def _h_ec_shard_read(self, h, path, q, body):
-        vid = int(q["volume"])
-        sid = int(q["shard"])
-        offset, size = int(q["offset"]), int(q["size"])
+        vid = _q_req_uint(q, "volume")
+        sid = _q_req_uint(q, "shard")
+        offset, size = _q_req_uint(q, "offset"), _q_req_uint(q, "size")
         ev = self.store.find_ec_volume(vid)
         if ev is None or sid not in ev.shards:
             return 404, {"error": f"shard {vid}.{sid} not here"}
@@ -973,7 +994,7 @@ class VolumeServer:
         """List live needle keys of a volume (volume.fsck's raw material;
         the reference streams the .idx in VolumeServer.CopyFile and the
         shell parses it — command_volume_fsck.go)."""
-        vid = int(q["volume"])
+        vid = _q_req_uint(q, "volume")
         v = self.store.find_volume(vid)
         if v is None:
             return 404, {"error": f"volume {vid} not found"}
@@ -997,8 +1018,8 @@ class VolumeServer:
         check reads append_ns to skip in-flight uploads)."""
         from ..storage.needle import get_actual_size
 
-        vid = int(q["volume"])
-        key = int(q["key"])
+        vid = _q_req_uint(q, "volume")
+        key = _q_req_uint(q, "key")
         v = self.store.find_volume(vid)
         if v is None:
             return 404, {"error": f"volume {vid} not found"}
@@ -1014,7 +1035,7 @@ class VolumeServer:
                 n = Needle.from_bytes(blob, nv.size, v.version,
                                       verify_crc=False)
                 append_ns = n.append_at_ns
-            except Exception:
+            except Exception:  # sweedlint: ok broad-except status probe; append_ns stays 0 for an unreadable needle
                 pass
         return 200, {
             "key": key,

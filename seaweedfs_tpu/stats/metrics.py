@@ -13,6 +13,8 @@ import threading
 import time
 from typing import Optional
 
+from ..util import glog
+
 
 def _fmt_labels(labels: dict) -> str:
     if not labels:
@@ -71,8 +73,9 @@ class Gauge:
             for key, fn in self._fns.items():
                 try:
                     items[key] = float(fn())
-                except Exception:
-                    pass
+                except Exception as e:
+                    glog.V(2).info("gauge %s callback failed: %s",
+                                   self.name, e)
         for key, v in sorted(items.items()):
             out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
         return out

@@ -11,6 +11,8 @@ from __future__ import annotations
 import os
 import threading
 
+from ..util.parsers import tolerant_uint
+
 
 class BackendStorageFile:
     def read_at(self, offset: int, size: int) -> bytes:
@@ -163,7 +165,7 @@ class RemoteS3File(BackendStorageFile):
             status, _, headers = self.client.head_object(bucket, key)
             if status != 200:
                 raise FileNotFoundError(f"s3://{bucket}/{key}: HTTP {status}")
-            self._size = int(headers.get("Content-Length", 0))
+            self._size = tolerant_uint(headers.get("Content-Length", 0), 0)
 
     def read_at(self, offset: int, size: int) -> bytes:
         if size <= 0 or offset >= self._size:

@@ -459,13 +459,13 @@ class SqliteNeedleMap(IdxLogMixin, NeedleMapper):
                 self._commit_meta()
                 self._db.commit()
             self._db.close()
-        except Exception:
+        except Exception:  # sweedlint: ok broad-except shutdown close; the mmap flush above already made state durable
             pass
 
     def destroy(self) -> None:
         self.close()
         try:
-            os.remove(self._db_path)
+            os.remove(self._db_path)  # sweedlint: ok durability destroy path; deletion is the goal and re-running is idempotent
         except FileNotFoundError:
             pass
 
@@ -487,6 +487,7 @@ def write_sorted_index(
                     offset_size,
                 )
             )
+    # sweedlint: ok durability atomic tmp+rename of derived data; .sdx rebuilds from .idx
     os.replace(sorted_path + ".tmp", sorted_path)
 
 

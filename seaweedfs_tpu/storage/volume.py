@@ -24,6 +24,7 @@ import time
 from typing import Callable, Iterator, Optional
 
 from ..util import faultpoints
+from ..util.parsers import tolerant_uint
 from .backend import BackendStorageFile, DiskFile
 from .needle import (
     CURRENT_VERSION,
@@ -145,8 +146,14 @@ class Volume:
         # readers of the file (EC encode reads the .idx of a live volume).
         # One 16-byte write(2) per put matches the reference's os.File.Write.
         idx_file = open(idx_path, "a+b", buffering=0)
-        self.nm = self._load_needle_map(idx_file)
-        self.last_append_at_ns = self._check_and_fix_integrity(idx_file)
+        try:
+            # ownership transfers to the needle map (nm.close() closes it);
+            # until then a load failure must not leak the unbuffered fd
+            self.nm = self._load_needle_map(idx_file)
+            self.last_append_at_ns = self._check_and_fix_integrity(idx_file)
+        except Exception:
+            idx_file.close()
+            raise
 
     def _load_needle_map(self, idx_file):
         kind = self.needle_map_kind
@@ -178,6 +185,7 @@ class Volume:
             ):
                 with open(idxp, "rb") as f:
                     write_sorted_index(f.read(), sdx, self.offset_size)
+            # sweedlint: ok lock-discipline load path; runs in __init__ before the volume is shared
             self.read_only = True
             return SortedFileNeedleMap(sdx, self.offset_size, idx_file)
         raise ValueError(f"unknown needle map kind {kind!r}")
@@ -190,17 +198,18 @@ class Volume:
     @read_only.setter
     def read_only(self, value: bool) -> None:
         self._read_only = value
-        if self.turbo is not None:
+        if self.turbo is not None:  # sweedlint: ok lock-discipline GIL-atomic reference read; attach/detach swap it under the lock
             self.turbo.set_readonly(self.id, value)
 
     def attach_turbo(self, engine, writable_http: bool = True) -> bool:
         """Hand the data plane to the native engine.  Refused for volume
         kinds the engine can't own safely (sorted/sealed maps, remote-tier
         backends, volume-level TTL inheritance)."""
-        if self.turbo is not None:
+        if self.turbo is not None:  # sweedlint: ok lock-discipline admin pre-check; attach is store-serialized, worst case re-attach returns True
             return True
         if self.needle_map_kind == "sorted":
             return False
+        # sweedlint: ok lock-discipline admin pre-check; tier moves exclude attach via the store
         if not isinstance(self.data_backend, DiskFile):
             return False  # remote tier: reads go through S3
         if self.ttl != EMPTY_TTL:
@@ -226,7 +235,7 @@ class Volume:
     def detach_turbo(self, reload_map: bool = True) -> None:
         """Take the data plane back; reload the Python needle map from the
         .idx the engine kept current."""
-        if self.turbo is None:
+        if self.turbo is None:  # sweedlint: ok lock-discipline admin pre-check; the locked block re-reads the reference
             return
         with self._lock:
             engine = self.turbo
@@ -264,28 +273,36 @@ class Volume:
 
     @property
     def version(self) -> int:
+        # sweedlint: ok lock-discipline GIL-atomic reference read; only the locked compact commit replaces super_block
         return self.super_block.version
 
     @property
     def ttl(self) -> TTL:
+        # sweedlint: ok lock-discipline GIL-atomic reference read; only the locked compact commit replaces super_block
         return self.super_block.ttl
 
     def content_size(self) -> int:
+        # sweedlint: ok lock-discipline heartbeat stat read; nm reference swaps are GIL-atomic
         return self.nm.content_size()
 
     def deleted_size(self) -> int:
+        # sweedlint: ok lock-discipline heartbeat stat read; nm reference swaps are GIL-atomic
         return self.nm.deleted_size()
 
     def file_count(self) -> int:
+        # sweedlint: ok lock-discipline heartbeat stat read; nm reference swaps are GIL-atomic
         return self.nm.file_count()
 
     def deleted_count(self) -> int:
+        # sweedlint: ok lock-discipline heartbeat stat read; nm reference swaps are GIL-atomic
         return self.nm.deleted_count()
 
     def max_file_key(self) -> int:
+        # sweedlint: ok lock-discipline heartbeat stat read; nm reference swaps are GIL-atomic
         return self.nm.max_file_key
 
     def size(self) -> int:
+        # sweedlint: ok lock-discipline heartbeat stat read; backend reference swaps are GIL-atomic
         return self.data_backend.size()
 
     def garbage_level(self) -> float:
@@ -330,7 +347,9 @@ class Volume:
             # reload the map (entries AND counters) without the torn tail;
             # release() drops any auxiliary handles (sqlite db) while the
             # shared idx handle stays open
+            # sweedlint: ok lock-discipline load path; runs in __init__ before the volume is shared
             self.nm.release()
+            # sweedlint: ok lock-discipline load path; runs in __init__ before the volume is shared
             self.nm = self._load_needle_map(idx_file)
         # Truncate any garbage .dat tail past the last verified record —
         # otherwise the next append starts at an unaligned/torn offset. (The
@@ -339,7 +358,7 @@ class Volume:
         if last_good is not None:
             _, aoff, size = last_good
             record_end = aoff + get_actual_size(max(size, 0), self.version)
-            if self.data_backend.size() > record_end:
+            if self.data_backend.size() > record_end:  # sweedlint: ok lock-discipline load path; runs in __init__ before the volume is shared
                 self.data_backend.truncate(record_end)
         return last_append_at_ns
 
@@ -350,6 +369,7 @@ class Volume:
             # tombstone entries point at the appended deletion needle
             # (verifyDeletedNeedleIntegrity): check it exists and matches
             blob_len = get_actual_size(0, self.version)
+            # sweedlint: ok lock-discipline called from the __init__ load path only
             blob = self.data_backend.read_at(aoff, blob_len)
             if len(blob) < blob_len:
                 return False, 0
@@ -362,6 +382,7 @@ class Volume:
                 return False, 0
             return True, n.append_at_ns
         blob_len = get_actual_size(size, self.version)
+        # sweedlint: ok lock-discipline called from the __init__ load path only
         blob = self.data_backend.read_at(aoff, blob_len)
         if len(blob) < blob_len:
             return False, 0
@@ -395,14 +416,16 @@ class Volume:
         append_at_ns: Optional[int] = None,
     ) -> tuple[int, int, bool]:
         """Returns (offset, size, is_unchanged)."""
-        if self.read_only:
-            raise VolumeError(f"volume {self.id} is read only")
         if n.ttl == EMPTY_TTL and self.ttl != EMPTY_TTL:
             from .needle import FLAG_HAS_TTL
 
             n.set_flag(FLAG_HAS_TTL)
             n.ttl = self.ttl
         with self._lock:
+            # under the lock: a write must not race past a concurrent
+            # mark-readonly (seal / tier move)
+            if self.read_only:
+                raise VolumeError(f"volume {self.id} is read only")
             actual_size = get_actual_size(len(n.data), self.version)
             if max_possible_volume_size(self.offset_size) < (
                 self.nm.content_size() + actual_size
@@ -452,10 +475,12 @@ class Volume:
     def _is_file_unchanged(self, n: Needle) -> bool:
         if str(self.ttl):
             return False
+        # sweedlint: ok lock-discipline called with self._lock held by write_needle
         nv = self.nm.get(n.id)
         if nv is None or nv.offset == 0 or not size_is_valid(nv.size):
             return False
         try:
+            # sweedlint: ok lock-discipline called with self._lock held by write_needle
             blob = self.data_backend.read_at(
                 nv.offset, get_actual_size(nv.size, self.version)
             )
@@ -471,9 +496,9 @@ class Volume:
         self, n: Needle, append_at_ns: Optional[int] = None
     ) -> int:
         """Returns the size of the deleted needle (0 if absent)."""
-        if self.read_only:
-            raise VolumeError(f"volume {self.id} is read only")
         with self._lock:
+            if self.read_only:
+                raise VolumeError(f"volume {self.id} is read only")
             nv = self.nm.get(n.id)
             if nv is None or not size_is_valid(nv.size):
                 return 0
@@ -525,10 +550,13 @@ class Volume:
         self, verify_crc: bool = False
     ) -> Iterator[tuple[Needle, int, int]]:
         """Yield (needle, offset, total_len) for every record in the .dat."""
+        # sweedlint: ok lock-discipline point-in-time scan; .dat is append-only below the snapshot size
         size = self.data_backend.size()
+        # sweedlint: ok lock-discipline GIL-atomic reference read; only the locked compact commit replaces super_block
         offset = self.super_block.block_size()
         version = self.version
         while offset + NEEDLE_HEADER_SIZE <= size:
+            # sweedlint: ok lock-discipline point-in-time scan; .dat is append-only below the snapshot size
             hdr = self.data_backend.read_at(offset, NEEDLE_HEADER_SIZE)
             if len(hdr) < NEEDLE_HEADER_SIZE:
                 break
@@ -538,6 +566,7 @@ class Volume:
             if offset + total > size:
                 break
             n = Needle(cookie=cookie, id=nid, size=nsize)
+            # sweedlint: ok lock-discipline point-in-time scan; .dat is append-only below the snapshot size
             body = self.data_backend.read_at(offset + NEEDLE_HEADER_SIZE, body_len)
             try:
                 n.read_body_bytes(body, version)
@@ -624,7 +653,10 @@ class Volume:
                         raise VolumeError(
                             f"tier object {bucket}/{key} missing: HTTP {status}"
                         )
-                    remote_size = int(headers.get("Content-Length", -1))
+                    # tolerant: a missing/garbage header yields -1 → size-mismatch error
+                    remote_size = tolerant_uint(
+                        headers.get("Content-Length", -1), -1
+                    )
                     if remote_size != size:
                         raise VolumeError(
                             f"tier object size {remote_size} != local {size}"
@@ -670,6 +702,7 @@ class Volume:
                 endpoint, bucket, key, access_key, secret_key, size=size
             )
             if not keep_local:
+                # sweedlint: ok durability past the .tier commit point; a crash leaves a harmless local copy
                 os.unlink(local)
             # never echo credentials back to callers (the handler serializes
             # this dict into an HTTP response)
@@ -742,7 +775,7 @@ class Volume:
         from ..util.throttler import WriteThrottler
         from .types import needle_map_entry_size
 
-        if self.turbo is not None:
+        if self.turbo is not None:  # sweedlint: ok lock-discipline admin pre-check; the reattach ctx re-reads under the lock
             # compaction rewrites the .dat/.idx pair: take the data plane
             # back for the duration, re-attach over the compacted files
             with self._turbo_reattach_ctx():
@@ -762,13 +795,13 @@ class Volume:
                 self.sync()
                 snap_dat = self.data_backend.size()
                 snap_idx = self.nm.index_file_size()
+                sb = self.super_block
             new_sb = SuperBlock(
                 version=version,
-                replica_placement=self.super_block.replica_placement,
-                ttl=self.super_block.ttl,
-                compaction_revision=(self.super_block.compaction_revision + 1)
-                & 0xFFFF,
-                extra=self.super_block.extra,
+                replica_placement=sb.replica_placement,
+                ttl=sb.ttl,
+                compaction_revision=(sb.compaction_revision + 1) & 0xFFFF,
+                extra=sb.extra,
             )
             # phase 1 (no lock): live map as of the snapshot, from the
             # immutable .idx prefix
@@ -790,8 +823,9 @@ class Volume:
             ) as dst_idx:
                 dst.write(new_sb.to_bytes())
                 new_offset = new_sb.block_size()
-                offset = self.super_block.block_size()
+                offset = sb.block_size()
                 while offset + NEEDLE_HEADER_SIZE <= snap_dat:
+                    # sweedlint: ok lock-discipline deliberate lock-free copy phase; bytes below snap_dat are immutable
                     hdr = self.data_backend.read_at(offset, NEEDLE_HEADER_SIZE)
                     if len(hdr) < NEEDLE_HEADER_SIZE:
                         break
@@ -809,6 +843,7 @@ class Volume:
                         and size_is_valid(lv[1])
                     ):
                         faultpoints.fire("vacuum.copy", path=base + ".cpd")
+                        # sweedlint: ok lock-discipline deliberate lock-free copy phase; bytes below snap_dat are immutable
                         dst.write(self.data_backend.read_at(offset, total))
                         dst_idx.write(
                             idx_mod.pack_entry(
@@ -867,7 +902,8 @@ class Volume:
                     dst_idx.close()
                     self._commit_compact(base)
         finally:
-            self._is_compacting = False
+            with self._lock:
+                self._is_compacting = False
 
     # Compact2 IS the compaction here; alias kept for reference parity
     compact2 = compact
@@ -878,6 +914,10 @@ class Volume:
         (every offset wrong); staging both renames behind one commit
         manifest makes the swap all-or-nothing across restarts
         (storage/commit.py)."""
+        with self._lock:
+            return self._commit_compact_locked(base)
+
+    def _commit_compact_locked(self, base: str) -> None:
         from .commit import StagedCommit
 
         self.data_backend.close()
@@ -895,15 +935,20 @@ class Volume:
             self.data_backend.read_at(0, SUPER_BLOCK_SIZE + extra_size)
         )
         idx_file = open(base + ".idx", "a+b", buffering=0)
-        self.nm = self._load_needle_map(idx_file)
+        try:
+            self.nm = self._load_needle_map(idx_file)
+        except Exception:
+            idx_file.close()
+            raise
 
     # -- lifecycle -----------------------------------------------------------
     def sync(self) -> None:
-        if self.turbo is not None:
-            self.turbo.sync(self.id)
-            return
-        self.data_backend.sync()
-        self.nm.sync()
+        with self._lock:
+            if self.turbo is not None:
+                self.turbo.sync(self.id)
+                return
+            self.data_backend.sync()
+            self.nm.sync()
 
     def close(self) -> None:
         self.detach_turbo(reload_map=False)
@@ -921,6 +966,7 @@ class Volume:
             for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx",
                         ".note", ".ldb"):
                 try:
+                    # sweedlint: ok durability destroy path; deletion is the goal, FileNotFoundError makes re-runs idempotent
                     os.remove(base + ext)
                 except FileNotFoundError:
                     pass

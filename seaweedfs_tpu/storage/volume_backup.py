@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 
 from ..server.http_util import http_bytes, http_bytes_headers, http_json
+from ..util.parsers import tolerant_uint
 from .needle import Needle, parse_needle_header
 from .needle import NEEDLE_HEADER_SIZE  # re-exported there
 from .volume import Volume, volume_file_name
@@ -70,12 +71,14 @@ def backup_volume(
             # compaction revision mismatch → full copy)
             for ext in (".dat", ".idx"):
                 if os.path.exists(base + ext):
+                    # sweedlint: ok durability revision-mismatch wipe; a crash mid-wipe re-detects and re-wipes next pass
                     os.unlink(base + ext)
             wiped = True
 
     start = os.path.getsize(base + ".dat") if os.path.exists(base + ".dat") else 0
     if start == 0 and os.path.exists(base + ".idx"):
-        os.unlink(base + ".idx")  # stale index with no .dat: force rebuild
+        # sweedlint: ok durability stale index with no .dat; next pass rebuilds from zero
+        os.unlink(base + ".idx")
     if start:
         # Resume from the last INDEXED record, not the raw .dat size: a
         # previous run may have crashed after fsyncing copied bytes but
@@ -102,7 +105,9 @@ def backup_volume(
             # these offsets are no longer a prefix of our copy. Abort before
             # appending garbage; the next run's revision check wipes and
             # restarts from 0 (volume_backup.go revision fencing per page).
-            page_rev = int(hdrs.get("X-Compaction-Revision", start_rev))
+            page_rev = tolerant_uint(
+                hdrs.get("X-Compaction-Revision", start_rev), start_rev
+            )
             if page_rev != start_rev:
                 # bytes copied this run straddle revisions — drop them all,
                 # leaving the local copy exactly as before the run

@@ -135,7 +135,7 @@ def _fire(name: str, path: Optional[str]) -> None:
         from . import glog
 
         glog.info("fault point %s firing: %s", name, kind)
-    except Exception:
+    except Exception:  # sweedlint: ok broad-except logging must never break fault injection
         pass
     if kind == "delay":
         time.sleep(arg if arg is not None else 0.05)

@@ -113,7 +113,9 @@ class MiniRedisServer:
                 self._expiry.pop(args[1], None)
                 rest = [a.upper() for a in args[3:]]
                 if b"EX" in rest:
-                    sec = int(args[3 + rest.index(b"EX") + 1])
+                    sec = int(  # sweedlint: ok strict-int ValueError becomes an -ERR protocol reply
+                        args[3 + rest.index(b"EX") + 1]
+                    )
                     if sec > 0:
                         self._expiry[args[1]] = time.time() + sec
                 return OK
@@ -138,7 +140,7 @@ class MiniRedisServer:
                 for i in range(2, len(args), 2):
                     member = args[i + 1]
                     added += int(member not in z)
-                    z[member] = float(args[i])
+                    z[member] = float(args[i])  # sweedlint: ok strict-int ValueError becomes -ERR; scores may be negative/float
                 return added
             if name == "ZREM":
                 z = self._zsets.get(args[1], {})
@@ -154,7 +156,7 @@ class MiniRedisServer:
             if name == "ZRANGE":
                 z = self._zsets.get(args[1], {})
                 members = sorted(z, key=lambda m: (z[m], m))
-                start, stop = int(args[2]), int(args[3])
+                start, stop = int(args[2]), int(args[3])  # sweedlint: ok strict-int ZRANGE indices are legally negative; ValueError becomes -ERR
                 n = len(members)
                 if start < 0:
                     start += n
@@ -184,7 +186,7 @@ class MiniRedisServer:
                 rest = [a.upper() for a in args[4:]]
                 if b"LIMIT" in rest:
                     i = 4 + rest.index(b"LIMIT")
-                    off, cnt = int(args[i + 1]), int(args[i + 2])
+                    off, cnt = int(args[i + 1]), int(args[i + 2])  # sweedlint: ok strict-int LIMIT count -1 is legal; ValueError becomes -ERR
                     out = out[off:] if cnt < 0 else out[off : off + cnt]
                 return out
             if name == "SCAN":

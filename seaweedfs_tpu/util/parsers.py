@@ -1,0 +1,55 @@
+"""Shared strict/tolerant parsers for wire-derived numbers.
+
+Plain ``int()`` accepts ``'+5'``, ``' 5 '``, ``'1_0'`` and unicode
+digits (``'²'`` makes ``isdigit()`` and ``int()`` disagree) — inputs
+AWS-compatible endpoints must reject outright and tolerant endpoints
+must clamp to a default.  Both disciplines live here so they cannot
+drift per-handler; the sweedlint ``strict-int`` rule points every
+request-int parse at this module (see docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+def parse_ascii_uint(s: str) -> int:
+    """Strict non-negative decimal: ascii digits only, raises ValueError.
+
+    The AWS-facing discipline (`max-keys`, `partNumber`, `X-Amz-Expires`):
+    a malformed value is the client's error and must surface as a 4xx,
+    never be coerced."""
+    if not isinstance(s, str) or not (s.isascii() and s.isdigit()):
+        raise ValueError(f"not a non-negative integer: {s!r}")
+    return int(s)
+
+
+def tolerant_uint(raw, default: Optional[int]) -> Optional[int]:
+    """Tolerant non-negative decimal: garbage and negatives fall back to
+    ``default``.
+
+    The reference-handler discipline (strconv.Atoi failures are ignored):
+    a client's bad ``?limit=`` must not surface as the daemon's 500, and
+    a negative count/limit/timestamp must not slice from the tail
+    (``events[:-5]`` silently drops the NEWEST entries)."""
+    if isinstance(raw, int):
+        return raw if raw >= 0 else default
+    try:
+        return parse_ascii_uint(raw)
+    except ValueError:
+        return default
+
+
+def tolerant_ufloat(raw, default: float) -> float:
+    """Tolerant non-negative float: garbage, negatives and non-finite
+    values fall back to ``default`` (NaN compares False against
+    everything, so a NaN deadline busy-loops ``Condition.wait``; an inf
+    timeout never expires)."""
+    try:
+        val = float(raw)
+    except (TypeError, ValueError):
+        return default
+    if not math.isfinite(val) or val < 0:
+        return default
+    return val

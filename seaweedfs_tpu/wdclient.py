@@ -98,7 +98,7 @@ def find_reachable_master(seeds: list[str], timeout: float = 2.0,
         try:
             http_json("GET", f"http://{m}/cluster/status", timeout=timeout)
             return m
-        except Exception:
+        except Exception:  # sweedlint: ok broad-except seed probe; an unreachable master is the expected case
             continue
     if strict:
         return ""
@@ -132,7 +132,7 @@ class MasterClient:
                 st = http_json("GET", f"http://{m}/cluster/status", timeout=3.0)
                 leader = st.get("leader") or m
                 return leader
-            except Exception:
+            except Exception:  # sweedlint: ok broad-except master probe; try the next seed
                 continue
         return None
 

@@ -1,0 +1,9 @@
+"""Fixture: same silent handler as broad_except_bad.py, waived —
+sweedlint must report nothing."""
+
+
+def refresh(client):
+    try:
+        client.poll()
+    except Exception:  # sweedlint: ok broad-except best-effort poll; the next tick retries
+        pass

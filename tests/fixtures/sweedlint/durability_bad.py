@@ -1,0 +1,7 @@
+"""Fixture: a raw os.replace of a .dat file outside StagedCommit —
+durability must fire exactly once."""
+import os
+
+
+def swap_in_compacted(base):
+    os.replace(base + ".cpd", base + ".dat")

@@ -1,0 +1,8 @@
+"""Fixture: same rename as durability_bad.py, waived — sweedlint must
+report nothing."""
+import os
+
+
+def swap_in_compacted(base):
+    # sweedlint: ok durability fixture; pretend this is inside a staged commit
+    os.replace(base + ".cpd", base + ".dat")

@@ -1,0 +1,17 @@
+"""Fixture: self.count is written under self._lock in add() but read
+lock-free in peek() — lock-discipline must fire exactly once (line of the
+peek read)."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def add(self, n):
+        with self._lock:
+            self.count += n
+
+    def peek(self):
+        return self.count

@@ -1,0 +1,17 @@
+"""Fixture: same race as lock_discipline_bad.py, waived with a reasoned
+suppression — sweedlint must report nothing."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def add(self, n):
+        with self._lock:
+            self.count += n
+
+    def peek(self):
+        # sweedlint: ok lock-discipline GIL-atomic int read for a stats probe
+        return self.count

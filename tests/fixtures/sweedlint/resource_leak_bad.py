@@ -1,0 +1,7 @@
+"""Fixture: open() bound to a local with no close anywhere in the
+function — resource-leak must fire exactly once."""
+
+
+def head_line(path):
+    f = open(path)
+    return f.readline()

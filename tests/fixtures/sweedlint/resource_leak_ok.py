@@ -1,0 +1,8 @@
+"""Fixture: same open() as resource_leak_bad.py, waived — sweedlint must
+report nothing."""
+
+
+def head_line(path):
+    # sweedlint: ok resource-leak fixture; ownership transfers to the caller
+    f = open(path)
+    return f.readline()

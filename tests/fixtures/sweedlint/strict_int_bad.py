@@ -1,0 +1,7 @@
+"""Fixture: bare int() on a request-dict value — strict-int must fire
+exactly once."""
+
+
+def handler(h, path, query, body):
+    limit = int(query.get("limit", 0))
+    return 200, {"limit": limit}

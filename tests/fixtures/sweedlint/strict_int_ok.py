@@ -1,0 +1,8 @@
+"""Fixture: same parse as strict_int_bad.py, waived — sweedlint must
+report nothing."""
+
+
+def handler(h, path, query, body):
+    # sweedlint: ok strict-int fixture; a ValueError here becomes a 400 upstream
+    limit = int(query.get("limit", 0))
+    return 200, {"limit": limit}

@@ -1,0 +1,132 @@
+"""Strict wire-integer parsing (util/parsers.py) and the call sites the
+strict-int sweep hardened: presigned-URL expiry fields and the query
+engine's ?limit."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.query import execute_request
+from seaweedfs_tpu.s3api.auth import (
+    ERR_ACCESS_DENIED,
+    ERR_MALFORMED_QUERY,
+    IAM,
+    Identity,
+)
+from seaweedfs_tpu.util.parsers import (
+    parse_ascii_uint,
+    tolerant_ufloat,
+    tolerant_uint,
+)
+
+
+# -- the parsers themselves ----------------------------------------------------
+
+def test_parse_ascii_uint_accepts_plain_digits():
+    assert parse_ascii_uint("0") == 0
+    assert parse_ascii_uint("604800") == 604800
+
+
+@pytest.mark.parametrize(
+    "bad", ["+5", "-5", " 5", "5 ", "1_0", "", "zz", "0x10", "²", "٥"]
+)
+def test_parse_ascii_uint_rejects_noncanonical(bad):
+    """Everything int() tolerates but the wire must not: signs, spaces,
+    underscores, and unicode digits where isdigit() and int() disagree."""
+    with pytest.raises(ValueError):
+        parse_ascii_uint(bad)
+
+
+def test_tolerant_uint_falls_back():
+    assert tolerant_uint("17", 3) == 17
+    assert tolerant_uint("+17", 3) == 3
+    assert tolerant_uint("-17", 3) == 3
+    assert tolerant_uint("zz", 3) == 3
+    assert tolerant_uint(None, 3) == 3
+    assert tolerant_uint(7, 3) == 7  # int passthrough
+    assert tolerant_uint(-7, 3) == 3  # negative int still clamps
+
+
+def test_tolerant_ufloat_rejects_nan_and_negatives():
+    assert tolerant_ufloat("1.5", 0.0) == 1.5
+    assert tolerant_ufloat("nan", 0.0) == 0.0
+    assert tolerant_ufloat("-2", 0.0) == 0.0
+    assert tolerant_ufloat("inf", 0.0) == 0.0
+    assert tolerant_ufloat("zz", 0.0) == 0.0
+
+
+# -- presigned URL expiry fields (s3api/auth.py) -------------------------------
+
+IAM_ONE = IAM([Identity("u", "AK", "SK", ["Admin"])])
+
+
+def _v4_query(**over):
+    q = {
+        "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+        "X-Amz-Credential": "AK/20260101/us-east-1/s3/aws4_request",
+        "X-Amz-SignedHeaders": "host",
+        "X-Amz-Signature": "0" * 64,
+        "X-Amz-Date": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+        "X-Amz-Expires": "900",
+    }
+    q.update(over)
+    return q
+
+
+@pytest.mark.parametrize("bad", ["+900", " 900", "900.0", "1_0", "zz", ""])
+def test_v4_presign_malformed_expires_is_a_client_error(bad):
+    """A garbage X-Amz-Expires used to hit bare int() and 500; it must be
+    AuthorizationQueryParametersError (a 400-class S3 auth error)."""
+    ident, err = IAM_ONE._check_v4_presigned(
+        "GET", "/b/k", _v4_query(**{"X-Amz-Expires": bad}), {"Host": "x"}
+    )
+    assert ident is None
+    assert err == ERR_MALFORMED_QUERY
+
+
+def test_v4_presign_wellformed_expires_reaches_signature_check():
+    """Digits-only expires must get past the parse (the fabricated
+    signature then fails, which is the point: not a parse error)."""
+    ident, err = IAM_ONE._check_v4_presigned(
+        "GET", "/b/k", _v4_query(), {"Host": "x"}
+    )
+    assert ident is None
+    assert err != ERR_MALFORMED_QUERY
+
+
+def test_v4_presign_error_maps_to_400():
+    from seaweedfs_tpu.s3api.s3api_server import _ERR_STATUS
+
+    assert _ERR_STATUS[ERR_MALFORMED_QUERY] == 400
+
+
+@pytest.mark.parametrize("bad", ["+1", "1.5e9", " 1", "zz"])
+def test_v2_presign_malformed_expires_is_denied(bad):
+    """V2 presign with a non-epoch Expires is AccessDenied (AWS rejects
+    the date format), never a coerced value and never a 500."""
+    ident, err = IAM_ONE._check_v2_presigned(
+        "GET", "/b/k",
+        {"AWSAccessKeyId": "AK", "Expires": bad, "Signature": "x"},
+    )
+    assert ident is None
+    assert err == ERR_ACCESS_DENIED
+
+
+# -- query engine ?limit (query/__init__.py) -----------------------------------
+
+ROWS = b'{"a": 1}\n{"a": 2}\n{"a": 3}\n'
+
+
+def test_query_limit_plain_digits():
+    status, out = execute_request(ROWS, {"input": "json", "limit": "2"})
+    assert status == 200 and out["count"] == 2
+
+
+@pytest.mark.parametrize("bad", ["-5", "+5", " 5 ", "zz", "1_0"])
+def test_query_limit_garbage_clamps_to_unlimited(bad):
+    """Garbage and negative limits fall back to the unlimited default —
+    int('-5') used to slice rows[:-5] and silently drop the newest."""
+    status, out = execute_request(ROWS, {"input": "json", "limit": bad})
+    assert status == 200 and out["count"] == 3

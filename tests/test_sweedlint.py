@@ -1,0 +1,116 @@
+"""sweedlint: fixture tests per rule + the tier-1 regression gate.
+
+The gate analyzes the whole ``seaweedfs_tpu`` package against the
+checked-in baseline (``tests/sweedlint_baseline.json``) and fails on any
+NEW violation *and* on any STALE baseline entry, so the baseline can only
+shrink.  Fixing a baselined site means deleting its line here too.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.analysis import (
+    analyze_file,
+    analyze_paths,
+    baseline_diff,
+    load_baseline,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures", "sweedlint")
+PACKAGE = os.path.join(os.path.dirname(HERE), "seaweedfs_tpu")
+BASELINE = os.path.join(HERE, "sweedlint_baseline.json")
+
+# (rule, fixture stem, relpath the scoped rules need to see)
+CASES = [
+    ("lock-discipline", "lock_discipline", "storage/fixture.py"),
+    ("durability", "durability", "storage/fixture.py"),
+    ("strict-int", "strict_int", "server/fixture.py"),
+    ("broad-except", "broad_except", "server/fixture.py"),
+    ("resource-leak", "resource_leak", "server/fixture.py"),
+]
+
+
+@pytest.mark.parametrize("rule,stem,rel", CASES, ids=[c[0] for c in CASES])
+def test_rule_fires_exactly_once_on_bad_fixture(rule, stem, rel):
+    found = analyze_file(os.path.join(FIXTURES, f"{stem}_bad.py"), rel)
+    assert [v.rule for v in found] == [rule], found
+
+
+@pytest.mark.parametrize("rule,stem,rel", CASES, ids=[c[0] for c in CASES])
+def test_suppression_silences_ok_fixture(rule, stem, rel):
+    found = analyze_file(os.path.join(FIXTURES, f"{stem}_ok.py"), rel)
+    assert found == [], found
+
+
+@pytest.mark.parametrize("rule,stem,rel", CASES, ids=[c[0] for c in CASES])
+def test_suppressing_a_different_rule_does_not_waive(rule, stem, rel, tmp_path):
+    """A waiver names the rule it waives; `ok other-rule reason` on the
+    offending line must not silence this rule."""
+    src = open(os.path.join(FIXTURES, f"{stem}_ok.py")).read()
+    other = "lock-discipline" if rule != "lock-discipline" else "durability"
+    src = src.replace(f"sweedlint: ok {rule}", f"sweedlint: ok {other}")
+    p = tmp_path / f"{stem}_cross.py"
+    p.write_text(src)
+    found = analyze_file(str(p), rel)
+    assert [v.rule for v in found] == [rule], found
+
+
+def test_reasonless_suppression_does_not_count(tmp_path):
+    """`# sweedlint: ok <rule>` with no reason is not a waiver."""
+    src = open(os.path.join(FIXTURES, "broad_except_ok.py")).read()
+    src = src.replace(
+        "# sweedlint: ok broad-except best-effort poll; the next tick retries",
+        "# sweedlint: ok broad-except",
+    )
+    p = tmp_path / "reasonless.py"
+    p.write_text(src)
+    found = analyze_file(str(p), "server/fixture.py")
+    assert [v.rule for v in found] == ["broad-except"], found
+
+
+def test_gate_package_is_clean_against_baseline():
+    """Tier-1 gate: no new violations anywhere in seaweedfs_tpu/, and no
+    baseline entry that stopped firing (stale waivers must be deleted)."""
+    violations = analyze_paths([PACKAGE])
+    new, stale = baseline_diff(violations, load_baseline(BASELINE))
+    msg = []
+    if new:
+        msg.append("new violations (fix or suppress with a reason):")
+        msg += [f"  {v}" for v in new]
+    if stale:
+        msg.append("stale baseline entries (delete from the baseline):")
+        msg += [f"  {e}" for e in stale]
+    assert not new and not stale, "\n".join(msg)
+
+
+def test_cli_exit_codes(tmp_path):
+    """The module CLI exits 0 on a clean tree and 1 on findings."""
+    import subprocess
+    import sys
+
+    bad = tmp_path / "storage"
+    bad.mkdir()
+    (bad / "thing.py").write_text(
+        "import os\n\ndef f(b):\n    os.replace(b + '.cpd', b + '.dat')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", str(bad)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(PACKAGE),
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "durability" in r.stdout
+    good = tmp_path / "clean"
+    good.mkdir()
+    (good / "thing.py").write_text("x = 1\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", str(good)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(PACKAGE),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
