@@ -505,10 +505,109 @@ class ResourceLeak:
         return False
 
 
+# -- rule 6: bounded-window ---------------------------------------------------
+
+
+class BoundedWindow:
+    """Concurrency without a visible bound. Two shapes:
+
+    - ``ThreadPoolExecutor()`` with no ``max_workers`` — the pool sizes
+      itself from the host's CPU count, so the same code ships a window
+      of 4 on the laptop and 64 in production (and each worker in the
+      data plane pins a chunk in memory: the window IS the memory bound,
+      docs/PERF.md);
+    - ``pool.submit(...)`` inside a ``for``/``while`` loop where ``pool``
+      is a raw ``ThreadPoolExecutor`` — submissions queue without limit,
+      so a large input materializes entirely in the pool's work queue.
+      Route the loop through ``util.pipeline.BoundedExecutor`` /
+      ``prefetch_iter`` (which block at the window), or carry a
+      suppression naming the external bound.
+
+    ``util/pipeline.py`` itself is exempt: it is the primitive the rule
+    tells everyone else to use."""
+
+    name = "bounded-window"
+
+    _EXEMPT = ("util/pipeline.py",)
+
+    def applies_to(self, relpath: str) -> bool:
+        return not any(relpath.endswith(e) for e in self._EXEMPT)
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and self._is_tpe(node):
+                if not node.args and not any(
+                    kw.arg == "max_workers" for kw in node.keywords
+                ):
+                    out.append(
+                        Violation(
+                            self.name,
+                            relpath,
+                            node.lineno,
+                            "ThreadPoolExecutor() without max_workers "
+                            "sizes itself from the host CPU count; pass "
+                            "an explicit window",
+                        )
+                    )
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_submit_loops(node, relpath))
+        return out
+
+    @staticmethod
+    def _is_tpe(call: ast.Call) -> bool:
+        return _func_name(call) == "ThreadPoolExecutor"
+
+    def _check_submit_loops(self, func, relpath) -> list[Violation]:
+        pools: set[str] = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ) and self._is_tpe(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        pools.add(tgt.id)
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if (
+                        isinstance(item.context_expr, ast.Call)
+                        and self._is_tpe(item.context_expr)
+                        and isinstance(item.optional_vars, ast.Name)
+                    ):
+                        pools.add(item.optional_vars.id)
+        if not pools:
+            return []
+        out = []
+        for node in ast.walk(func):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            for call in ast.walk(node):
+                if (
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "submit"
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id in pools
+                ):
+                    out.append(
+                        Violation(
+                            self.name,
+                            relpath,
+                            call.lineno,
+                            f"{call.func.value.id}.submit in a loop queues "
+                            "without an in-flight bound; use util.pipeline."
+                            "BoundedExecutor/prefetch_iter or suppress "
+                            "naming the external bound",
+                        )
+                    )
+        return out
+
+
 RULES = [
     LockDiscipline(),
     Durability(),
     StrictInt(),
     BroadExcept(),
     ResourceLeak(),
+    BoundedWindow(),
 ]

@@ -498,12 +498,25 @@ def _encode_pipelined(dat, items, codec, outputs, dat_size: int,
         )
         return width, data, parity_dev
 
+    # the D2H leg dominates end-to-end at large chunk sizes; pulling the m
+    # parity rows as m concurrent row-sized transfers instead of one
+    # array-sized one overlaps them on runtimes with per-transfer setup
+    # cost (and degrades to the same bytes moved on those without)
+    from concurrent.futures import ThreadPoolExecutor
+
+    fetch_pool = ThreadPoolExecutor(
+        max_workers=max(1, min(m, 4)), thread_name_prefix="ec-d2h"
+    )
+
     def fetch(got):
         width, data, parity_dev = got
         if parity_dev is None:
             return width, data, None
         # the blocking D2H leg: overlaps the next chunk's H2D + dispatch
-        return width, data, np.asarray(parity_dev)
+        rows = list(
+            fetch_pool.map(np.asarray, (parity_dev[j] for j in range(m)))
+        )
+        return width, data, rows
 
     def consume(got):
         faultpoints.fire("ec.encode.chunk", path=outputs[0].name)
@@ -515,9 +528,14 @@ def _encode_pipelined(dat, items, codec, outputs, dat_size: int,
         for i in range(k):
             outputs[i].write(data[i, :width].tobytes())
         for j in range(m):
-            outputs[k + j].write(parity[j, :width].tobytes())
+            # parity[j] indexing (not parity[j, ...]) so both a 2-D array
+            # and the row list from the parallel fetch work here
+            outputs[k + j].write(parity[j][:width].tobytes())
 
-    _overlap_pipeline(produce, compute, consume, fetch=fetch, stats=stats)
+    try:
+        _overlap_pipeline(produce, compute, consume, fetch=fetch, stats=stats)
+    finally:
+        fetch_pool.shutdown(wait=True)
 
 
 def rebuild_ec_files(

@@ -9,10 +9,15 @@ from __future__ import annotations
 
 import json
 import urllib.parse
-import urllib.request
 from typing import Optional
 
-from ..server.http_util import http_bytes, http_json
+from ..server.http_util import (
+    http_bytes,
+    http_bytes_headers,
+    http_json,
+    http_stream_request,
+    http_stream_response,
+)
 
 
 class FilerClient:
@@ -24,6 +29,10 @@ class FilerClient:
         return self.base + urllib.parse.quote(path) + ("?" + qs if qs else "")
 
     # -- object level ---------------------------------------------------------
+    # All four object calls ride the pooled keep-alive transport
+    # (http_util): a gateway→filer hop per part/chunk no longer pays TCP
+    # setup + slow-start; worker threads in the pipelined paths each keep
+    # their own warm socket (the pool is thread-local).
     def put_object(
         self,
         path: str,
@@ -32,17 +41,21 @@ class FilerClient:
         extended: Optional[dict] = None,
         signatures: Optional[list[int]] = None,
     ) -> dict:
-        req = urllib.request.Request(
-            self._u(path, sig=",".join(map(str, signatures or []))),
-            data=body,
-            method="PUT",
-        )
+        headers = {}
         if content_type:
-            req.add_header("Content-Type", content_type)
+            headers["Content-Type"] = content_type
         for k, v in (extended or {}).items():
-            req.add_header(f"Seaweed-{k}", v)
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            return json.loads(resp.read())
+            headers[f"Seaweed-{k}"] = v
+        status, data, _ = http_bytes_headers(
+            "PUT",
+            self._u(path, sig=",".join(map(str, signatures or []))),
+            body=body,
+            timeout=60,
+            headers=headers,
+        )
+        if status >= 300:
+            raise IOError(f"PUT {path}: HTTP {status} {data[:200]!r}")
+        return json.loads(data)
 
     def put_object_stream(
         self,
@@ -52,11 +65,11 @@ class FilerClient:
         content_type: str = "",
         extended: Optional[dict] = None,
     ) -> dict:
-        """PUT with the body streamed from a file-like source: urllib feeds
-        http.client's blocksize loop, and the filer's streaming write path
-        chunks it on arrival — an upload of any size flows end-to-end in
-        bounded memory. The source is clamped to `length` bytes and a short
-        read raises instead of silently truncating."""
+        """PUT with the body streamed from a file-like source: http.client's
+        blocksize loop feeds the pooled socket, and the filer's streaming
+        write path chunks it on arrival — an upload of any size flows
+        end-to-end in bounded memory. The source is clamped to `length`
+        bytes and a short read raises instead of silently truncating."""
 
         class _Exact:
             def __init__(self, src, left):
@@ -72,16 +85,18 @@ class FilerClient:
                 self._left -= len(got)
                 return got
 
-        req = urllib.request.Request(
-            self._u(path), data=_Exact(rfile, length), method="PUT"
-        )
-        req.add_header("Content-Length", str(length))
+        headers = {}
         if content_type:
-            req.add_header("Content-Type", content_type)
+            headers["Content-Type"] = content_type
         for k, v in (extended or {}).items():
-            req.add_header(f"Seaweed-{k}", v)
-        with urllib.request.urlopen(req, timeout=600) as resp:
-            return json.loads(resp.read())
+            headers[f"Seaweed-{k}"] = v
+        status, data, _ = http_stream_request(
+            "PUT", self._u(path), _Exact(rfile, length), length,
+            headers=headers, timeout=600,
+        )
+        if status >= 300:
+            raise IOError(f"PUT {path}: HTTP {status} {data[:200]!r}")
+        return json.loads(data)
 
     def get_object_stream(
         self, path: str, rng: Optional[str] = None
@@ -91,28 +106,18 @@ class FilerClient:
         instead of buffering whole objects (pairs with the filer's
         streaming read path). The caller must .close() the response; error
         statuses return the (small) error body as bytes instead."""
-        req = urllib.request.Request(self._u(path), method="GET")
-        if rng:
-            req.add_header("Range", rng)
-        try:
-            resp = urllib.request.urlopen(req, timeout=600)
-            return resp.status, resp, dict(resp.headers)
-        except urllib.error.HTTPError as e:
-            body = e.read()
-            e.close()
-            return e.code, body, dict(e.headers)
+        return http_stream_response(
+            "GET", self._u(path),
+            headers={"Range": rng} if rng else None, timeout=600,
+        )
 
     def get_object(
         self, path: str, rng: Optional[str] = None
     ) -> tuple[int, bytes, dict]:
-        req = urllib.request.Request(self._u(path), method="GET")
-        if rng:
-            req.add_header("Range", rng)
-        try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                return resp.status, resp.read(), dict(resp.headers)
-        except urllib.error.HTTPError as e:
-            return e.code, e.read(), dict(e.headers)
+        return http_bytes_headers(
+            "GET", self._u(path),
+            headers={"Range": rng} if rng else None, timeout=60,
+        )
 
     # -- entry level ----------------------------------------------------------
     def get_entry(self, path: str) -> Optional[dict]:

@@ -40,11 +40,18 @@ class WFS:
         meta_cache_db: str = ":memory:",
         use_meta_cache: bool = True,
         cipher: Optional[bool] = None,
+        read_window: int = 4,
+        write_window: int = 4,
     ):
         self.client = FilerClient(filer_url)
         self.chunk_size = chunk_size
         self.collection = collection
         self.ttl = ttl
+        # data-plane pipeline depths (util/pipeline.py): bounded windows of
+        # concurrent chunk uploads / ranged sub-reads per operation. Peak
+        # extra memory per call is window × chunk_size (docs/PERF.md).
+        self.read_window = max(1, read_window)
+        self.write_window = max(1, write_window)
         if cipher is None:
             # honor the filer's -encryptVolumeData setting the way the
             # reference mount reads GetFilerConfiguration (wfs.go:55) —
@@ -227,37 +234,54 @@ class WFS:
             return f.read(0, f.size())
 
     # -- chunk upload (wfs_write.go saveDataAsChunk) -------------------------
+    def _save_one_chunk(self, piece: bytes, chunk_offset: int) -> FileChunk:
+        a = self.client.assign(collection=self.collection, ttl=self.ttl)
+        if a.get("error"):
+            raise WfsError(f"assign: {a['error']}")
+        payload, cipher_key_b64 = piece, ""
+        if self.cipher:
+            # fresh key per chunk; the volume stores ciphertext and the
+            # entry holds the key, same as filer POST (_write_cipher.go)
+            import base64
+
+            from ..util import cipher as cipher_mod
+
+            key = cipher_mod.gen_cipher_key()
+            payload = cipher_mod.encrypt(piece, key)
+            cipher_key_b64 = base64.b64encode(key).decode()
+        operation.upload_data(a["url"], a["fid"], payload, jwt=a.get("auth", ""))
+        return FileChunk(
+            file_id=a["fid"],
+            offset=chunk_offset,
+            size=len(piece),
+            mtime=time.time_ns(),
+            cipher_key=cipher_key_b64,
+        )
+
     def save_data_as_chunks(self, data: bytes, base_offset: int) -> list[FileChunk]:
-        chunks = []
-        pos = 0
-        while pos < len(data):
-            piece = data[pos : pos + self.chunk_size]
-            a = self.client.assign(collection=self.collection, ttl=self.ttl)
-            if a.get("error"):
-                raise WfsError(f"assign: {a['error']}")
-            payload, cipher_key_b64 = piece, ""
-            if self.cipher:
-                # fresh key per chunk; the volume stores ciphertext and the
-                # entry holds the key, same as filer POST (_write_cipher.go)
-                import base64
+        """Assign+encrypt+upload each chunk_size piece; multi-piece runs go
+        through a bounded window of concurrent uploads so chunk k+1 is on
+        the wire while chunk k finishes (wfs_write.go saveDataAsChunk under
+        concurrentWriters). Chunk order in the returned list is piece
+        order; on any failure the window is settled before raising — like
+        the reference mount, already-uploaded pieces of an uncommitted run
+        are leaked to the volume (vacuum reclaims them), never committed."""
+        pieces = [
+            (data[pos : pos + self.chunk_size], base_offset + pos)
+            for pos in range(0, len(data), self.chunk_size)
+        ]
+        if len(pieces) <= 1 or self.write_window <= 1:
+            return [self._save_one_chunk(p, off) for p, off in pieces]
+        from ..util.pipeline import BoundedExecutor
 
-                from ..util import cipher as cipher_mod
-
-                key = cipher_mod.gen_cipher_key()
-                payload = cipher_mod.encrypt(piece, key)
-                cipher_key_b64 = base64.b64encode(key).decode()
-            operation.upload_data(a["url"], a["fid"], payload, jwt=a.get("auth", ""))
-            chunks.append(
-                FileChunk(
-                    file_id=a["fid"],
-                    offset=base_offset + pos,
-                    size=len(piece),
-                    mtime=time.time_ns(),
-                    cipher_key=cipher_key_b64,
-                )
-            )
-            pos += len(piece)
-        return chunks
+        pipe = BoundedExecutor(self.write_window, name="wfs-write")
+        try:
+            for piece, off in pieces:
+                pipe.submit(self._save_one_chunk, piece, off)
+        except BaseException:
+            pipe.abort()  # settle in-flight uploads, then surface the error
+            raise
+        return pipe.drain()  # submit order == piece order
 
 
 class FileHandle:
@@ -353,6 +377,41 @@ class FileHandle:
             if self.wfs.meta_cache:
                 self.wfs.meta_cache.invalidate(self.path)
 
+    def _read_committed(self, lo: int, hi: int) -> bytes:
+        """Fetch committed bytes [lo, hi] inclusive from the filer. Spans
+        larger than one chunk split into chunk_size sub-ranges pulled
+        through a read_window-deep prefetcher (util/pipeline.py) — each
+        worker holds its own pooled keep-alive socket to the filer, so a
+        big mount read rides several connections while this thread
+        reassembles them in order."""
+        from ..util.pipeline import prefetch_iter
+
+        step = self.wfs.chunk_size
+        spans = [
+            (s, min(s + step - 1, hi)) for s in range(lo, hi + 1, step)
+        ]
+
+        def fetch(span):
+            s, e = span
+            status, data, _ = self.wfs.client.get_object(
+                self.path, rng=f"bytes={s}-{e}"
+            )
+            if status not in (200, 206):
+                raise WfsError(f"read {self.path}: HTTP {status}")
+            return data
+
+        window = self.wfs.read_window if len(spans) > 1 else 1
+        out = bytearray(hi - lo + 1)
+        pos = 0
+        fetched = prefetch_iter(spans, fetch, window)
+        try:
+            for _, data in fetched:
+                out[pos : pos + len(data)] = data
+                pos += len(data)
+        finally:
+            fetched.close()
+        return bytes(out[:pos])
+
     # -- read path -----------------------------------------------------------
     def read(self, offset: int, size: int) -> bytes:
         with self._lock:
@@ -364,11 +423,7 @@ class FileHandle:
             committed = self.entry.file_size()
             if offset < committed:
                 hi = min(end, committed) - 1
-                status, data, _ = self.wfs.client.get_object(
-                    self.path, rng=f"bytes={offset}-{hi}"
-                )
-                if status not in (200, 206):
-                    raise WfsError(f"read {self.path}: HTTP {status}")
+                data = self._read_committed(offset, hi)
                 base[: len(data)] = data
             # overlay still-dirty bytes (read-your-writes)
             for lo, data in self.dirty.read_data_at(offset, want):

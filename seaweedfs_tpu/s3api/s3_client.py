@@ -173,6 +173,15 @@ class S3Client:
             + urllib.parse.quote(path, safe="/~-._")
             + ("?" + qs if qs else "")
         )
+        if url.startswith("http://"):
+            # plain-http endpoints ride the pooled keep-alive transport;
+            # https keeps urllib for this client's custom ssl_context
+            from ..server.http_util import http_bytes_headers
+
+            return http_bytes_headers(
+                method, url, body=body if body else None,
+                timeout=30, headers=headers,
+            )
         req = urllib.request.Request(
             url, data=body if body else None, method=method, headers=headers
         )
@@ -230,6 +239,12 @@ class S3Client:
             framed += f"{len(c):x};chunk-signature={prev}\r\n".encode()
             framed += c + b"\r\n"
         url = self.endpoint + urllib.parse.quote(path, safe="/~-._")
+        if url.startswith("http://"):
+            from ..server.http_util import http_bytes_headers
+
+            return http_bytes_headers(
+                "PUT", url, body=bytes(framed), timeout=30, headers=headers
+            )
         req = urllib.request.Request(
             url, data=bytes(framed), method="PUT", headers=headers
         )

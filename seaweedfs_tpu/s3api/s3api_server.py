@@ -30,6 +30,7 @@ from ..server.http_util import (
     start_server,
 )
 from ..util.parsers import parse_ascii_uint
+from ..util.pipeline import BoundedExecutor, prefetch_iter
 from . import auth as s3auth
 from . import policy_engine as pe
 from . import post_policy as pp
@@ -606,9 +607,10 @@ class S3ApiServer:
             # a duplicated PartNumber would assemble that part's chunks
             # twice; AWS rejects the request rather than guessing
             return _err("InvalidPart", key, "duplicate part number")
-        chunks, md5_digests, offset = [], [], 0
-        for part in sorted(part_numbers):
-            pe = self.client.get_entry(f"{UPLOADS_DIR}/{upload_id}/{part:05d}.part")
+        def _part_entry(part):
+            pe = self.client.get_entry(
+                f"{UPLOADS_DIR}/{upload_id}/{part:05d}.part"
+            )
             if pe is None:
                 # uploads in flight across the 04d→05d field-width upgrade
                 # stored their parts under the legacy name; completing them
@@ -616,14 +618,29 @@ class S3ApiServer:
                 pe = self.client.get_entry(
                     f"{UPLOADS_DIR}/{upload_id}/{part:04d}.part"
                 )
-            if pe is None:
-                return _err("InvalidPart", str(part))
-            md5_digests.append(bytes.fromhex(pe.get("extended", {}).get("md5", "")))
-            for c in sorted(pe.get("chunks", []), key=lambda c: c["offset"]):
-                c = dict(c)
-                c["offset"] = offset + c["offset"]
-                chunks.append(c)
-            offset = max((c["offset"] + c["size"] for c in chunks), default=offset)
+            return pe
+
+        # part metadata fetches are independent filer round-trips; a
+        # windowed prefetch (util/pipeline.py) overlaps them while this
+        # thread assembles the chunk list strictly in part order
+        chunks, md5_digests, offset = [], [], 0
+        fetched = prefetch_iter(sorted(part_numbers), _part_entry, window=8)
+        try:
+            for part, pe in fetched:
+                if pe is None:
+                    return _err("InvalidPart", str(part))
+                md5_digests.append(
+                    bytes.fromhex(pe.get("extended", {}).get("md5", ""))
+                )
+                for c in sorted(pe.get("chunks", []), key=lambda c: c["offset"]):
+                    c = dict(c)
+                    c["offset"] = offset + c["offset"]
+                    chunks.append(c)
+                offset = max(
+                    (c["offset"] + c["size"] for c in chunks), default=offset
+                )
+        finally:
+            fetched.close()
         etag = hashlib.md5(b"".join(md5_digests)).hexdigest() + f"-{len(part_numbers)}"
         now = int(time.time())
         self.client.create_entry(
@@ -642,9 +659,20 @@ class S3ApiServer:
         wanted = {f"{p:05d}.part" for p in part_numbers} | {
             f"{p:04d}.part" for p in part_numbers
         }
-        for e in self.client.list(f"{UPLOADS_DIR}/{upload_id}", limit=10001):
-            if e["name"].endswith(".part") and e["name"] not in wanted:
-                self.client.delete(f"{UPLOADS_DIR}/{upload_id}/{e['name']}")
+        stale = [
+            e["name"]
+            for e in self.client.list(f"{UPLOADS_DIR}/{upload_id}", limit=10001)
+            if e["name"].endswith(".part") and e["name"] not in wanted
+        ]
+        if stale:
+            # each delete purges that part's chunks on the volumes — slow,
+            # independent round-trips, so run them under a bounded window
+            pipe = BoundedExecutor(window=8, name="s3-purge")
+            for name in stale:
+                pipe.submit(
+                    self.client.delete, f"{UPLOADS_DIR}/{upload_id}/{name}"
+                )
+            pipe.drain()
         # referenced parts' meta goes away; their chunks now belong to the
         # target entry
         self.client.delete(

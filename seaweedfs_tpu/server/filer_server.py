@@ -38,7 +38,7 @@ from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import MAX_INT64, view_from_chunks
 from ..filer.filer import Filer
 from ..filer.filerstore import NotFoundError, SqliteStore
-from ..util import glog
+from ..util import faultpoints, glog
 from ..util.parsers import tolerant_ufloat, tolerant_uint
 from ..wdclient import MasterClient
 from .http_util import (
@@ -65,6 +65,79 @@ class _VidLookup:
         self._mc.vid_map.invalidate(vid)
 
 
+class _FidBatch:
+    """Batched fid source for the overlapped write path: each master
+    assign(count=n) reserves n consecutive needle keys (the sequencer bumps
+    once), handed out as base, base_1 … base_{n-1} — ``_<delta>`` suffix
+    fids that parse_path/FileId.parse resolve to key+delta with the base
+    cookie (needle.go ParsePath).
+
+    All fids of one batch land on the base fid's volume, so a refill pulls
+    ``lanes`` batches (the master's pick_for_write randomizes the volume
+    per call) and DEALS them round-robin: consecutive pieces go to
+    different volume servers and the write window aggregates their ingest
+    bandwidth instead of queueing on one server — one batch per window
+    would hand the whole in-flight window to a single volume and measure
+    its lock, not the pipeline.
+
+    Auth: master tokens are fid-scoped and cover only the base fid, so
+    suffix fids are self-signed with the filer's shared signing key. When
+    the cluster enforces auth and this filer holds no signing key, the
+    reserved suffixes are unusable — each piece then falls back to its own
+    single assign (the skipped needle ids are never written; a sequencer
+    gap is harmless)."""
+
+    def __init__(self, fs: "FilerServer", collection: str, replication: str,
+                 ttl: str, batch: int, lanes: int = 1):
+        self._fs = fs
+        self._collection = collection
+        self._replication = replication
+        self._ttl = ttl
+        self._batch = max(1, batch)
+        self._lanes = max(1, lanes)
+        self._pending: list[operation.Assignment] = []
+        self._lock = threading.Lock()
+
+    def _one_batch(self) -> list[operation.Assignment]:
+        a = operation.assign(
+            self._fs.master_url,
+            count=self._batch,
+            collection=self._collection,
+            replication=self._replication,
+            ttl=self._ttl,
+        )
+        got = max(1, a.count)
+        usable = got if (not a.auth or self._fs.jwt_signing_key) else 1
+        lane = [a]
+        for delta in range(1, usable):
+            fid = f"{a.fid}_{delta}"
+            auth = ""
+            if a.auth:
+                from ..security import gen_jwt
+
+                auth = gen_jwt(self._fs.jwt_signing_key, fid)
+            lane.append(operation.Assignment(
+                fid=fid, url=a.url, public_url=a.public_url,
+                count=1, auth=auth,
+            ))
+        return lane
+
+    def next(self) -> operation.Assignment:
+        with self._lock:
+            if not self._pending:
+                lanes = [self._one_batch() for _ in range(self._lanes)]
+                # round-robin deal so neighboring pieces hit distinct
+                # volumes; .pop() serves from the end, so build reversed
+                dealt = [
+                    lane[i]
+                    for i in range(max(len(ln) for ln in lanes))
+                    for lane in lanes
+                    if i < len(lane)
+                ]
+                self._pending = dealt[::-1]
+            return self._pending.pop()
+
+
 class FilerServer:
     def __init__(
         self,
@@ -84,6 +157,8 @@ class FilerServer:
         peers: Optional[list[str]] = None,
         meta_log_dir: str = "",
         store=None,
+        read_window: int = 4,
+        write_window: int = 4,
     ):
         from ..stats import default_registry
         from ..util.chunk_cache import TieredChunkCache
@@ -110,6 +185,11 @@ class FilerServer:
         self.replication = replication
         self.cipher = cipher
         self.manifest_batch = manifest_batch
+        # data-plane pipeline depths (util/pipeline.py): N-deep chunk
+        # read-ahead on GET, N uploads in flight on PUT; 1 = serial. Peak
+        # extra memory per request is window × chunk_size (docs/PERF.md)
+        self.read_window = max(1, read_window)
+        self.write_window = max(1, write_window)
         if not meta_log_dir and db_path not in ("", ":memory:"):
             # persist beside the store, but per-filer: two filers SHARING one
             # store (a supported topology) must not interleave segments or
@@ -293,9 +373,11 @@ class FilerServer:
             # GetFilerConfiguration analog: mount/sync clients must know to
             # encrypt their chunks when the filer runs -encryptVolumeData
             "cipher": self.cipher,
-            "chunk_cache": {
-                "hits": self.chunk_cache.mem.hits,
-                "misses": self.chunk_cache.mem.misses,
+            # mem- AND disk-tier hit/miss counters (TieredChunkCache.stats)
+            "chunk_cache": self.chunk_cache.stats(),
+            "pipeline": {
+                "read_window": self.read_window,
+                "write_window": self.write_window,
             },
         }
 
@@ -406,21 +488,50 @@ class FilerServer:
         ttl = q.get("ttl") or rule.ttl or ""
         use_cipher = self.cipher or q.get("cipher") == "true"
         chunks: list[FileChunk] = []
-        uploaded_fids: list[str] = []  # every fid stored, incl. manifest blobs
+        uploaded_fids: list[str] = []  # every fid ASSIGNED, incl. manifest blobs
         md5 = hashlib.md5()
         offset = 0
+        window = self.write_window
+        pipe = None
         try:
-            while offset < length:
-                piece = self._read_exact(
-                    rfile, min(self.chunk_size, length - offset)
-                )
-                md5.update(piece)
-                chunk = self._upload_piece(
-                    piece, offset, collection, replication, ttl, use_cipher
-                )
-                uploaded_fids.append(chunk.file_id)
-                chunks.append(chunk)
-                offset += len(piece)
+            if window > 1 and length > self.chunk_size:
+                # overlapped autochunk (uploadReaderToChunks): the socket
+                # read of piece k+1 proceeds while pieces k, k-1, … are in
+                # assign+encrypt+upload flight; submit blocks once `window`
+                # uploads are pending, so resident data stays bounded at
+                # window × chunk_size
+                from ..util.pipeline import BoundedExecutor
+
+                n_pieces = -(-length // self.chunk_size)
+                assigner = _FidBatch(
+                    self, collection, replication, ttl,
+                    batch=min(n_pieces, max(2, window)),
+                    lanes=min(n_pieces, window),
+                ).next
+                pipe = BoundedExecutor(window, name="filer-write")
+                while offset < length:
+                    piece = self._read_exact(
+                        rfile, min(self.chunk_size, length - offset)
+                    )
+                    md5.update(piece)
+                    pipe.submit(
+                        self._upload_piece, piece, offset, collection,
+                        replication, ttl, use_cipher,
+                        assigner=assigner, record=uploaded_fids.append,
+                    )
+                    offset += len(piece)
+                chunks = pipe.drain()  # submit order == offset order
+            else:
+                while offset < length:
+                    piece = self._read_exact(
+                        rfile, min(self.chunk_size, length - offset)
+                    )
+                    md5.update(piece)
+                    chunks.append(self._upload_piece(
+                        piece, offset, collection, replication, ttl,
+                        use_cipher, record=uploaded_fids.append,
+                    ))
+                    offset += len(piece)
             if len(chunks) >= self.manifest_batch:
                 from ..filer.filechunk_manifest import maybe_manifestize
 
@@ -451,7 +562,12 @@ class FilerServer:
             self.filer.create_entry(entry, signatures=self._sigs(q))
         except Exception:
             # nothing was committed (create_entry is the commit point):
-            # don't leak ANY stored chunk — data or manifest blob
+            # don't leak ANY stored chunk — data or manifest blob. The
+            # in-flight window is settled FIRST so the purge sees the
+            # complete set of assigned fids (a worker mid-upload when the
+            # socket read failed must not add a fid after the purge ran).
+            if pipe is not None:
+                pipe.abort()
             if uploaded_fids:
                 self._purge_chunks(uploaded_fids)
             raise
@@ -464,13 +580,20 @@ class FilerServer:
         }
 
     def _upload_piece(self, piece: bytes, offset: int, collection: str,
-                      replication: str, ttl: str, use_cipher: bool) -> FileChunk:
-        a = operation.assign(
+                      replication: str, ttl: str, use_cipher: bool,
+                      assigner=None, record=None) -> FileChunk:
+        a = assigner() if assigner is not None else operation.assign(
             self.master_url,
             collection=collection,
             replication=replication,
             ttl=ttl,
         )
+        if record is not None:
+            # record BEFORE uploading: a piece that fails (or crashes) mid-
+            # upload must still have its fid purged by the caller — deleting
+            # a never-written needle is a no-op, leaking a written one isn't
+            record(a.fid)
+        faultpoints.fire("filer.write.piece")
         cipher_key_b64 = ""
         payload = piece
         if use_cipher:
@@ -679,47 +802,67 @@ class FilerServer:
 
     def _stream_range(self, entry: Entry, offset: int, size: int):
         """Generator of body pieces for [offset, offset+size): chunk views
-        are fetched (cache-aside) and yielded one at a time, decrypting per
-        chunk; implicit gaps between views stream as zeros in bounded
-        pieces, matching the buffered assembly in _read_range byte for
-        byte. A two-slot plaintext memo keeps interleaved views over two
-        fids from re-decrypting per transition while bounding memory. The
-        FIRST piece is produced eagerly, so a failure fetching the first
-        chunk (volume down) still surfaces as a 500 — only mid-body
-        failures degrade to a short 200 body (the connection is dropped so
-        the client sees truncation, http_util._reply_stream)."""
+        are fetched (cache-aside) with an N-deep read-ahead — up to
+        ``read_window`` upcoming chunk fids in concurrent flight while the
+        current piece streams (reader_cache.go MaybeCache) — and yielded
+        strictly in view order, decrypting per chunk; implicit gaps between
+        views stream as zeros in bounded pieces, matching the buffered
+        assembly in _read_range byte for byte regardless of the window. A
+        two-slot plaintext memo keeps interleaved views over two fids from
+        re-decrypting per transition while bounding memory. The FIRST piece
+        is produced eagerly, so a failure fetching the first chunk (volume
+        down) still surfaces as a 500 — only mid-body failures degrade to a
+        short 200 body (the connection is dropped so the client sees
+        truncation, http_util._reply_stream)."""
         views = view_from_chunks(self._resolve_chunks(entry.chunks), offset, size)
         end = offset + size
 
         def produce():
             from collections import OrderedDict
 
+            from ..util.pipeline import prefetch_iter
+
+            window = self.read_window
+            if len({v.file_id for v in views}) <= 1:
+                window = 1  # nothing ahead to prefetch; skip the pool
             pos = offset
             memo: OrderedDict[str, bytes] = OrderedDict()
-            for view in views:
-                data = memo.get(view.file_id)
-                if data is None:
-                    data = self._fetch_chunk(view.file_id)
-                    if view.cipher_key:
-                        from ..util import cipher as cipher_mod
+            fetched = prefetch_iter(
+                views,
+                lambda v: self._fetch_chunk(v.file_id),
+                window,
+                key=lambda v: v.file_id,  # single-flight per fid
+            )
+            try:
+                for view, raw in fetched:
+                    data = memo.get(view.file_id)
+                    if data is None:
+                        data = raw
+                        if view.cipher_key:
+                            from ..util import cipher as cipher_mod
 
-                        data = cipher_mod.decrypt(
-                            data, base64.b64decode(view.cipher_key)
-                        )
-                    memo[view.file_id] = data
-                    while len(memo) > 2:
-                        memo.popitem(last=False)
-                if view.logic_offset > pos:  # sparse gap
-                    gap = view.logic_offset - pos
-                    while gap > 0:
-                        n = min(self._ZERO_PIECE, gap)
-                        yield b"\x00" * n
-                        gap -= n
-                        pos += n
-                piece = data[view.offset : view.offset + view.size]
-                if piece:
-                    yield piece
-                    pos += len(piece)
+                            data = cipher_mod.decrypt(
+                                data, base64.b64decode(view.cipher_key)
+                            )
+                        memo[view.file_id] = data
+                        while len(memo) > 2:
+                            memo.popitem(last=False)
+                    if view.logic_offset > pos:  # sparse gap
+                        gap = view.logic_offset - pos
+                        while gap > 0:
+                            n = min(self._ZERO_PIECE, gap)
+                            yield b"\x00" * n
+                            gap -= n
+                            pos += n
+                    piece = data[view.offset : view.offset + view.size]
+                    if piece:
+                        yield piece
+                        pos += len(piece)
+            finally:
+                # client gone mid-stream: shut the prefetcher down without
+                # waiting so the handler thread is never wedged on unread
+                # read-ahead
+                fetched.close()
             tail = end - pos
             while tail > 0:
                 n = min(self._ZERO_PIECE, tail)

@@ -517,6 +517,210 @@ def _pooled_request(
     raise last_err  # unreachable; keeps type checkers honest
 
 
+def _conn_is_stale(conn) -> bool:
+    """True when a pooled keep-alive socket is no longer usable: a peer
+    that closed (or half-closed) the connection leaves it readable with
+    EOF pending, while a healthy idle HTTP/1.1 socket has nothing to
+    read. Used before NON-retryable sends (streaming bodies can't be
+    rewound, so the one-shot stale retry of _pooled_request is off the
+    table — probing is the next best defense)."""
+    sock = getattr(conn, "sock", None)
+    if sock is None:
+        return False  # never connected; the dial below is fresh anyway
+    import select
+
+    try:
+        readable, _, _ = select.select([sock], [], [], 0)
+    except (OSError, ValueError):
+        return True
+    return bool(readable)
+
+
+def _checkout_conn(key: tuple, timeout: float):
+    """The calling thread's pooled connection for (host, port), stale-probed,
+    or a fresh one. Returns (conn, conns_dict); the conn is REMOVED from the
+    pool — the caller re-pools it via _repool when its response is done."""
+    conns = getattr(_pool_local, "conns", None)
+    if conns is None:
+        conns = _pool_local.conns = {}
+    conn = conns.pop(key, None)
+    if conn is not None and _conn_is_stale(conn):
+        conn.close()
+        conn = None
+    if conn is None:
+        conn = _NoDelayHTTPConnection.get()(key[0], key[1], timeout=timeout)
+    elif conn.sock is not None:
+        conn.sock.settimeout(timeout)
+    return conn, conns
+
+
+def _repool(conn, key: tuple, conns: dict) -> None:
+    if key in conns:  # another request pooled its own conn meanwhile
+        conn.close()
+    else:
+        conns[key] = conn
+
+
+def http_stream_request(
+    method: str,
+    url: str,
+    reader,
+    length: int,
+    headers: Optional[dict] = None,
+    timeout: float = 600.0,
+) -> tuple[int, bytes, dict]:
+    """Request whose body streams from a file-like source over the pooled
+    keep-alive transport (http://; anything else falls back to urllib).
+    A consumed reader cannot be rewound, so there is NO stale-socket
+    retry — instead the pooled socket is liveness-probed before the first
+    byte goes out (the common stale case: peer restarted while idle)."""
+    hdrs = dict(headers or {})
+    hdrs.setdefault("Content-Length", str(length))
+    if not url.startswith("http://"):
+        req = urllib.request.Request(
+            url, data=reader, method=method, headers=hdrs
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+    u = urllib.parse.urlsplit(url)
+    key = (u.hostname, u.port)
+    path = u.path + (f"?{u.query}" if u.query else "")
+    conn, conns = _checkout_conn(key, timeout)
+    try:
+        conn.blocksize = 1 << 20  # stream MB pieces, not 8KB sips
+        # explicit Content-Length + file-like body: http.client streams
+        # the reader in blocksize pieces (no buffering, no chunked TE)
+        conn.request(method, path, body=reader, headers=hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        resp_headers = dict(resp.getheaders())
+        if resp.will_close:
+            conn.close()
+        else:
+            _repool(conn, key, conns)
+        return resp.status, data, resp_headers
+    except Exception:
+        conn.close()
+        raise
+
+
+class _PooledStreamBody:
+    """File-like over a pooled connection's in-flight response body: bytes
+    stay on the wire until read. Reading to EOF hands the socket back to
+    the calling thread's pool; closing with unread bytes (or a read
+    error) drops it — the framing is unusable mid-body."""
+
+    def __init__(self, resp, conn, key, conns):
+        self._resp, self._conn = resp, conn
+        self._key, self._conns = key, conns
+        self._owner = threading.get_ident()
+        self._done = False
+
+    def read(self, n: int = -1) -> bytes:
+        try:
+            data = self._resp.read(n)
+        except Exception:
+            self._discard()
+            raise
+        if self._resp.isclosed():
+            self._settle()
+        return data
+
+    def _settle(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        if self._resp.will_close or threading.get_ident() != self._owner:
+            # conns is the CREATOR thread's pool; repooling from another
+            # thread would share one http.client conn across threads
+            self._conn.close()
+        else:
+            _repool(self._conn, self._key, self._conns)
+
+    def _discard(self) -> None:
+        if not self._done:
+            self._done = True
+            self._conn.close()
+
+    def close(self) -> None:
+        if self._resp.isclosed():
+            self._settle()
+        else:
+            self._discard()
+        try:
+            self._resp.close()
+        except Exception:  # sweedlint: ok broad-except socket already torn down; nothing to report
+            pass
+
+
+def http_stream_response(
+    method: str,
+    url: str,
+    headers: Optional[dict] = None,
+    timeout: float = 600.0,
+) -> tuple[int, object, dict]:
+    """Request whose RESPONSE body stays on the wire: returns (status,
+    file-like body, headers) for success statuses — the caller reads
+    piecewise and must close() — or (status, small error bytes, headers)
+    for >= 400. http:// rides the pooled keep-alive transport (the conn is
+    checked out of the pool until the body is fully read, so a nested
+    request to the same peer on this thread gets its own socket);
+    anything else falls back to urllib."""
+    if not url.startswith("http://"):
+        req = urllib.request.Request(url, method=method, headers=headers or {})
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout)
+            return resp.status, resp, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            e.close()
+            return e.code, body, dict(e.headers)
+    u = urllib.parse.urlsplit(url)
+    key = (u.hostname, u.port)
+    path = u.path + (f"?{u.query}" if u.query else "")
+    import http.client
+
+    may_retry = method in _IDEMPOTENT_METHODS
+    last_err: Optional[Exception] = None
+    for attempt in (0, 1):
+        conn, conns = _checkout_conn(key, timeout)
+        fresh = conn.sock is None
+        try:
+            conn.request(method, path, headers=headers or {})
+            resp = conn.getresponse()
+        except (
+            http.client.RemoteDisconnected,
+            http.client.BadStatusLine,
+            ConnectionResetError,
+            BrokenPipeError,
+        ) as e:
+            # idle-close race on a reused socket (same discipline as
+            # _pooled_request): no body was streamed yet, so a one-shot
+            # re-dial is safe for idempotent methods
+            conn.close()
+            last_err = e
+            if fresh or attempt or not may_retry:
+                raise
+            continue
+        except Exception:
+            conn.close()
+            raise
+        if resp.status >= 400:
+            data = resp.read()
+            resp_headers = dict(resp.getheaders())
+            if resp.will_close:
+                conn.close()
+            else:
+                _repool(conn, key, conns)
+            return resp.status, data, resp_headers
+        body = _PooledStreamBody(resp, conn, key, conns)
+        return resp.status, body, dict(resp.getheaders())
+    raise last_err  # unreachable; keeps type checkers honest
+
+
 def http_json(
     method: str,
     url: str,

@@ -41,7 +41,7 @@ from ..storage.needle import (
 )
 from ..storage.store import Store
 from ..storage.volume import DeletedError, NotFoundError, volume_file_name
-from ..util import glog
+from ..util import faultpoints, glog
 from ..util.parsers import tolerant_uint
 from .http_util import (
     BadRequest,
@@ -184,6 +184,9 @@ class VolumeServer:
         if not self._auth_ok(h, path, q, self.jwt_read_key):
             return 401, {"error": "unauthorized read"}
         self._req_count.inc(op="get")
+        # chaos/bench hook: delay here models cross-machine RTT + disk seek
+        # per needle read (the wait the filer's read-ahead window hides)
+        faultpoints.fire("volume.read.needle")
         with self._req_hist.time(op="get"):
             vid, nid, cookie = self._parse_fid_path(path)
             n = Needle(id=nid)
@@ -371,6 +374,9 @@ class VolumeServer:
             return 403, {"error": "ip not allowed"}
         if not self._auth_ok(h, path, q, self.jwt_signing_key):
             return 401, {"error": "unauthorized write"}
+        # chaos/bench hook: delay here models cross-machine RTT + disk
+        # latency per needle write (the wait the write window overlaps)
+        faultpoints.fire("volume.write.needle")
         vid, nid, cookie = self._parse_fid_path(path)
         n = Needle(cookie=cookie, id=nid, data=bytes(body))
         name = h.headers.get("X-Sweed-Name")

@@ -518,10 +518,31 @@ class WebDavServer:
                     b"",
                 )
         else:
-            status, data, _ = self.client.get_object(src_fp)
+            # stream source→destination through the gateway: the GET body
+            # feeds the PUT piecewise over two pooled sockets, so a COPY of
+            # any size runs in bounded memory (and the filer overlaps its
+            # own chunk uploads underneath, server/filer_server.py)
+            status, data, h = self.client.get_object_stream(src_fp)
             if status != 200:
+                if hasattr(data, "close"):
+                    data.close()
                 return 404, b"", {}
-            self.client.put_object(dst_fp, data, content_type=entry.get("mime", ""))
+            if hasattr(data, "read"):
+                clen = h.get("Content-Length")
+                if clen is None:  # broken upstream; never guess a length
+                    data.close()
+                    return 502, b"", {}
+                try:
+                    self.client.put_object_stream(
+                        dst_fp, data, int(clen),
+                        content_type=entry.get("mime", ""),
+                    )
+                finally:
+                    data.close()
+            else:
+                self.client.put_object(
+                    dst_fp, data, content_type=entry.get("mime", "")
+                )
         return 204 if existed else 201, b"", {}
 
     # --------------------------------------------------------------- lifecycle

@@ -65,5 +65,9 @@ class FileId:
         if comma <= 0:
             raise ValueError(f"wrong fid format {fid!r}")
         vid = int(fid[:comma])
-        key, cookie = parse_needle_id_cookie(fid[comma + 1 :])
+        # accept the ``_<delta>`` batch-assign suffix like parse_path does
+        # (needle.go ParsePath): assign(count=n) hands out base, base_1 …
+        # base_{n-1} and those fids flow through entry chunk lists into
+        # lookup/delete grouping, which parses them here
+        key, cookie = parse_path(fid[comma + 1 :])
         return cls(vid, key, cookie)

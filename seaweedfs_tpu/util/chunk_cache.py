@@ -46,24 +46,54 @@ class MemoryChunkCache:
 class DiskChunkCache:
     """Size-classed spill tier. One file per chunk, fid-hashed name; evicts
     oldest-mtime files once over budget (the reference reuses volume-file
-    machinery per 1×/4×/16× unit class — same role, simpler store)."""
+    machinery per 1×/4×/16× unit class — same role, simpler store).
+
+    A running byte total makes ``put`` O(1): the tree walk that used to run
+    on EVERY put now runs once at startup (cold-cache inventory) and again
+    only when the running total crosses the budget. ``get`` touches the
+    file's mtime so eviction order is true LRU, not insertion order."""
 
     def __init__(self, directory: str, budget_bytes: int = 1024 * 1024 * 1024):
         self.dir = directory
         self.budget = budget_bytes
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self._bytes = self._walk_bytes()
 
     def _path(self, fid: str) -> str:
         h = hashlib.sha1(fid.encode()).hexdigest()
         return os.path.join(self.dir, h[:2], h[2:])
 
+    def _walk_bytes(self) -> int:
+        total = 0
+        for root, _, names in os.walk(self.dir):
+            for n in names:
+                try:
+                    total += os.stat(os.path.join(root, n)).st_size
+                except FileNotFoundError:
+                    continue
+        return total
+
     def get(self, fid: str) -> Optional[bytes]:
+        p = self._path(fid)
         try:
-            with open(self._path(fid), "rb") as f:
-                return f.read()
+            with open(p, "rb") as f:
+                data = f.read()
         except FileNotFoundError:
+            with self._lock:
+                self.misses += 1
             return None
+        try:
+            # mtime is the LRU clock _evict sorts by: a read must refresh
+            # it or a hot chunk written long ago is the first one evicted
+            os.utime(p)
+        except OSError:
+            pass  # already-evicted race; the data is still good
+        with self._lock:
+            self.hits += 1
+        return data
 
     def put(self, fid: str, data: bytes) -> None:
         p = self._path(fid)
@@ -71,11 +101,21 @@ class DiskChunkCache:
         tmp = p + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
+        try:
+            old = os.stat(p).st_size
+        except FileNotFoundError:
+            old = 0
         os.replace(tmp, p)
         with self._lock:
-            self._evict()
+            self._bytes += len(data) - old
+            if self._bytes > self.budget:
+                self._evict_locked()
 
-    def _evict(self) -> None:
+    def _evict_locked(self) -> None:
+        """Walk + LRU-unlink down to budget. Only reached when the running
+        total says we are over, so the O(n) walk is paid per overflow, not
+        per put; the walk also resyncs the running total against ground
+        truth (external deletions, crashed tmp files)."""
         entries = []
         total = 0
         for root, _, names in os.walk(self.dir):
@@ -87,6 +127,7 @@ class DiskChunkCache:
                     continue
                 entries.append((st.st_mtime, st.st_size, p))
                 total += st.st_size
+        self._bytes = total
         if total <= self.budget:
             return
         entries.sort()
@@ -95,8 +136,8 @@ class DiskChunkCache:
                 os.unlink(p)
             except FileNotFoundError:
                 continue
-            total -= size
-            if total <= self.budget:
+            self._bytes -= size
+            if self._bytes <= self.budget:
                 break
 
 
@@ -126,6 +167,15 @@ class TieredChunkCache:
                 self.mem.put(fid, data)  # promote
             return data
         return None
+
+    def stats(self) -> dict:
+        """Per-tier hit/miss counters for the filer /_status payload."""
+        return {
+            "hits": self.mem.hits,
+            "misses": self.mem.misses,
+            "disk_hits": self.disk.hits if self.disk else 0,
+            "disk_misses": self.disk.misses if self.disk else 0,
+        }
 
     def put(self, fid: str, data: bytes) -> None:
         if len(data) <= self.mem_limit:

@@ -1,0 +1,9 @@
+"""Fixture: ThreadPoolExecutor() with no max_workers — bounded-window
+must fire exactly once."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+
+def fan_out(fetch, items):
+    pool = ThreadPoolExecutor()
+    return [pool.submit(fetch, item) for item in items]

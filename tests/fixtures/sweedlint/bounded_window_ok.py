@@ -1,0 +1,13 @@
+"""Fixture: explicit max_workers plus a waived submit loop — sweedlint
+must report nothing."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+
+def fan_out(fetch, items):
+    pool = ThreadPoolExecutor(max_workers=4)
+    futures = []
+    for item in items:
+        # sweedlint: ok bounded-window items is capped at 8 by the caller
+        futures.append(pool.submit(fetch, item))
+    return futures

@@ -1,0 +1,260 @@
+"""Unit tests for util/pipeline.py — the bounded-concurrency primitives
+under the pipelined filer data plane.
+
+These run without any cluster: fetches are plain callables gated on
+threading.Event so the tests can hold the window open and observe
+ordering, dedup, blocking, and shutdown behavior deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.util.pipeline import BoundedExecutor, prefetch_iter
+
+
+# ---------------------------------------------------------------- prefetch
+
+
+def test_prefetch_yields_in_input_order():
+    items = list(range(20))
+    seen = list(prefetch_iter(items, lambda i: i * i, window=4))
+    assert seen == [(i, i * i) for i in items]
+
+
+def test_prefetch_window_one_is_serial():
+    calls = []
+
+    def fetch(i):
+        calls.append(i)
+        return i
+
+    gen = prefetch_iter([1, 2, 3], fetch, window=1)
+    assert next(gen) == (1, 1)
+    # serial path: nothing is fetched ahead of the consumer
+    assert calls == [1]
+    assert list(gen) == [(2, 2), (3, 3)]
+    assert calls == [1, 2, 3]
+
+
+def test_prefetch_order_survives_slow_fetch():
+    """A slow fetch for item k must not let k+1 overtake it."""
+
+    def fetch(i):
+        if i == 0:
+            time.sleep(0.05)
+        return i
+
+    seen = [item for item, _ in prefetch_iter(range(6), fetch, window=4)]
+    assert seen == list(range(6))
+
+
+def test_prefetch_single_flight_dedup():
+    """Interleaved views over the same fid (A,B,A,B) share one in-flight
+    fetch per key instead of racing duplicates."""
+    counts: dict = {}
+    lock = threading.Lock()
+
+    def fetch(item):
+        k = item[0]
+        with lock:
+            counts[k] = counts.get(k, 0) + 1
+        return k.upper()
+
+    # key collides on the first tuple element; window spans the repeats
+    items = [("a", 1), ("b", 2), ("a", 3), ("b", 4)]
+    out = list(prefetch_iter(items, fetch, window=4, key=lambda t: t[0]))
+    assert out == [(i, i[0].upper()) for i in items]
+    assert counts == {"a": 1, "b": 1}
+
+
+def test_prefetch_dedup_refetches_after_window_passes():
+    """Dedup is single-flight, not a cache: once every pending view of a
+    key has been yielded, a later view of the same key fetches again."""
+    counts = {"a": 0}
+
+    def fetch(item):
+        counts["a"] += 1
+        return counts["a"]
+
+    # window=2 ⟹ the two "a" views are never pending together
+    items = ["a", "x", "y", "z", "a"]
+    out = list(prefetch_iter(items, fetch, window=2, key=lambda s: s))
+    assert out[0] == ("a", 1)
+    assert out[-1][0] == "a" and out[-1][1] >= 2
+
+
+def test_prefetch_error_propagates_at_position():
+    def fetch(i):
+        if i == 2:
+            raise ValueError("boom")
+        return i
+
+    gen = prefetch_iter(range(5), fetch, window=4)
+    assert next(gen) == (0, 0)
+    assert next(gen) == (1, 1)
+    with pytest.raises(ValueError, match="boom"):
+        next(gen)
+
+
+def test_prefetch_first_item_error_is_eager():
+    """Error on the very first item surfaces on the first next() — the
+    filer's eager-first-piece semantics (500, not a truncated 200)."""
+
+    def fetch(i):
+        raise OSError("no volume")
+
+    gen = prefetch_iter([1, 2, 3], fetch, window=8)
+    with pytest.raises(OSError, match="no volume"):
+        next(gen)
+
+
+def test_prefetch_close_does_not_block_on_inflight():
+    """Closing the generator mid-stream (client disconnect) must return
+    promptly even while a fetch is wedged."""
+    release = threading.Event()
+
+    def fetch(i):
+        if i > 0:
+            release.wait(5)
+        return i
+
+    gen = prefetch_iter(range(8), fetch, window=4)
+    assert next(gen) == (0, 0)
+    t0 = time.monotonic()
+    gen.close()  # wedged fetches are still in flight
+    assert time.monotonic() - t0 < 1.0
+    release.set()
+
+
+def test_prefetch_close_is_idempotent_and_stops_iteration():
+    gen = prefetch_iter(range(100), lambda i: i, window=4)
+    next(gen)
+    gen.close()
+    gen.close()
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_prefetch_bounds_inflight_fetches():
+    """No more than `window` fetches are started ahead of the consumer."""
+    started = []
+    lock = threading.Lock()
+    gate = threading.Event()
+
+    def fetch(i):
+        with lock:
+            started.append(i)
+        gate.wait(5)
+        return i
+
+    gen = prefetch_iter(range(50), fetch, window=3)
+    # give the pool time to overfill if it were going to
+    time.sleep(0.2)
+    try:
+        with lock:
+            assert len(started) <= 3, started
+    finally:
+        gate.set()
+        assert [i for i, _ in gen] == list(range(50))
+
+
+# ---------------------------------------------------------- BoundedExecutor
+
+
+def test_executor_drain_returns_submit_order():
+    pipe = BoundedExecutor(window=4, name="t")
+
+    def work(i):
+        if i % 2 == 0:
+            time.sleep(0.02)
+        return i * 10
+
+    for i in range(8):
+        pipe.submit(work, i)
+    assert pipe.drain() == [i * 10 for i in range(8)]
+
+
+def test_executor_submit_blocks_at_window():
+    """The producer self-throttles: submit #window+1 blocks until a slot
+    frees, capping resident data at window × chunk size."""
+    gate = threading.Event()
+    pipe = BoundedExecutor(window=2, name="t")
+    pipe.submit(gate.wait, 5)
+    pipe.submit(gate.wait, 5)
+
+    blocked = threading.Event()
+    unblocked = threading.Event()
+
+    def producer():
+        blocked.set()
+        pipe.submit(lambda: None)
+        unblocked.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    assert blocked.wait(2)
+    assert not unblocked.wait(0.2), "third submit should block at window=2"
+    gate.set()
+    assert unblocked.wait(2), "submit must unblock once a slot frees"
+    pipe.drain()
+    t.join(2)
+
+
+def test_executor_failfast_submit_after_error():
+    pipe = BoundedExecutor(window=2, name="t")
+
+    def bad():
+        raise RuntimeError("upload failed")
+
+    pipe.submit(bad)
+    # wait for the failure to land, then the next submit raises it
+    deadline = time.monotonic() + 2
+    while pipe._first_error is None and time.monotonic() < deadline:
+        time.sleep(0.005)
+    with pytest.raises(RuntimeError, match="upload failed"):
+        pipe.submit(lambda: None)
+    pipe.abort()
+
+
+def test_executor_drain_raises_after_all_settle():
+    """drain() raises the first error only after EVERY task settled, so
+    the caller's purge sees the complete uploaded-fid set."""
+    done = []
+    all_submitted = threading.Event()
+
+    def work(i):
+        all_submitted.wait(5)
+        if i == 1:
+            raise ValueError("chunk 1 died")
+        time.sleep(0.03)
+        done.append(i)
+        return i
+
+    pipe = BoundedExecutor(window=4, name="t")
+    for i in range(4):
+        pipe.submit(work, i)
+    all_submitted.set()
+    with pytest.raises(ValueError, match="chunk 1 died"):
+        pipe.drain()
+    assert sorted(done) == [0, 2, 3]
+
+
+def test_executor_abort_settles_and_swallows():
+    done = []
+    pipe = BoundedExecutor(window=3, name="t")
+    pipe.submit(lambda: done.append(1))
+    pipe.submit(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    pipe.submit(lambda: done.append(2))
+    pipe.abort()  # must not raise
+    assert sorted(done) == [1, 2]
+
+
+def test_executor_window_floor_is_one():
+    pipe = BoundedExecutor(window=0, name="t")
+    assert pipe.window == 1
+    pipe.submit(lambda: 7)
+    assert pipe.drain() == [7]

@@ -31,6 +31,7 @@ CASES = [
     ("strict-int", "strict_int", "server/fixture.py"),
     ("broad-except", "broad_except", "server/fixture.py"),
     ("resource-leak", "resource_leak", "server/fixture.py"),
+    ("bounded-window", "bounded_window", "server/fixture.py"),
 ]
 
 
