@@ -1,7 +1,9 @@
 """sweedlint — project-specific static analysis for seaweedfs_tpu.
 
 Every rule encodes a bug class this repo has actually shipped (see
-docs/ANALYSIS.md for the history behind each one):
+docs/ANALYSIS.md for the history behind each one).
+
+Per-file rules (``rules.py``):
 
 - ``lock-discipline`` — attributes written under ``with self._lock`` must
   not be touched outside it (lightweight race detector).
@@ -13,6 +15,21 @@ docs/ANALYSIS.md for the history behind each one):
   span auth/context construction.
 - ``resource-leak``  — ``open()`` handles need ``with``, a tracked
   ``.close()``, or an ownership transfer the code can show.
+- ``bounded-window`` — raw unbounded ``ThreadPoolExecutor`` submit loops
+  must go through ``util/pipeline.py``.
+
+Interprocedural rules (``callgraph.py`` + ``lockgraph.py`` +
+``taint.py``), which see the whole project at once:
+
+- ``lock-order``          — a cycle in the lock acquisition-order graph
+  (potential ABBA deadlock), computed transitively through the call
+  graph.
+- ``blocking-under-lock`` — a network/disk/sleep/``Future.result`` call
+  reachable while a lock is held.
+- ``tainted-size``        — a wire-derived value flowing into a
+  seek/read/slice/allocation size without ``util/parsers.py``.
+- ``stale-waiver``        — a ``sweedlint: ok`` comment naming a rule
+  that no longer fires on the line it covers (waiver rot).
 
 Run it as ``python -m seaweedfs_tpu.analysis``.  A finding is waived with
 an inline comment on the offending line (or the line above)::
@@ -21,6 +38,9 @@ an inline comment on the offending line (or the line above)::
 
 The reason is mandatory: a suppression with no reason does not count and
 the violation stands, so every waiver in the tree is self-documenting.
+The stale-waiver audit closes the other half of that contract: a waiver
+whose rule stopped firing is itself a finding, so the exception list
+can only describe code that still needs excepting.
 """
 
 from __future__ import annotations
@@ -34,7 +54,6 @@ from typing import Iterable, Optional
 
 __all__ = [
     "Violation",
-    "RULES",
     "analyze_file",
     "analyze_paths",
     "baseline_diff",
@@ -78,28 +97,120 @@ def _suppressed_lines(src_lines: list[str]) -> dict[int, set[str]]:
     return out
 
 
-def analyze_file(path: str, relpath: Optional[str] = None) -> list[Violation]:
-    """All un-suppressed violations in one source file."""
-    from . import rules as _rules
+def _audit_waivers(
+    parsed: list[tuple[str, ast.AST, list[str]]],
+    fired: set[tuple[str, str, int]],
+) -> list[Violation]:
+    """stale-waiver: every ``sweedlint: ok <rule>`` comment must have a
+    live ``<rule>`` finding on the line it covers (its own or the next).
 
-    rel = (relpath or path).replace(os.sep, "/")
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Violation("parse-error", rel, e.lineno or 0, str(e.msg))]
-    src_lines = src.splitlines()
-    waived = _suppressed_lines(src_lines)
+    Two rounds so that stale-waiver findings are themselves waivable:
+    round one audits waivers naming ordinary rules; round two audits
+    waivers naming ``stale-waiver`` against round one's output (a
+    ``# sweedlint: ok stale-waiver ...`` comment with nothing stale
+    beneath it is itself rot).
+    """
+    comments: list[tuple[str, int, str]] = []
+    for rel, _tree, src_lines in parsed:
+        for i, text in enumerate(src_lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                comments.append((rel, i, m.group("rule")))
+
+    def live(rel: str, i: int, rule: str, in_: set) -> bool:
+        return (rel, rule, i) in in_ or (rel, rule, i + 1) in in_
+
+    out: list[Violation] = []
+    for rel, i, rule in comments:
+        if rule != "stale-waiver" and not live(rel, i, rule, fired):
+            out.append(
+                Violation(
+                    "stale-waiver",
+                    rel,
+                    i,
+                    f"waiver names '{rule}' but no {rule} finding fires on "
+                    "this line or the next — the code was fixed or the "
+                    "comment drifted; delete it",
+                )
+            )
+    fired2 = fired | {(v.path, v.rule, v.line) for v in out}
+    for rel, i, rule in comments:
+        if rule == "stale-waiver" and not live(rel, i, rule, fired2):
+            out.append(
+                Violation(
+                    "stale-waiver",
+                    rel,
+                    i,
+                    "waiver names 'stale-waiver' but nothing stale is "
+                    "waived on this line or the next; delete it",
+                )
+            )
+    return out
+
+
+def _analyze(
+    file_entries: list[tuple[str, str]], audit_waivers: bool
+) -> list[Violation]:
+    """Shared engine: per-file rules on each module, then the
+    interprocedural rules over the project they jointly form, then the
+    waiver audit, then suppression filtering — in that order, because a
+    waiver must be able to silence an interprocedural finding and the
+    audit must see pre-suppression results."""
+    from . import rules as _rules
+    from .callgraph import Project
+
+    project = Project()
+    parsed: list[tuple[str, ast.AST, list[str]]] = []
     found: list[Violation] = []
-    for rule in _rules.RULES:
-        if not rule.applies_to(rel):
+    for full, rel in file_entries:
+        with open(full, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=full)
+        except SyntaxError as e:
+            found.append(Violation("parse-error", rel, e.lineno or 0, str(e.msg)))
             continue
-        found.extend(rule.check(tree, rel))
-    return sorted(
-        (v for v in found if v.rule not in waived.get(v.line, ())),
-        key=lambda v: (v.line, v.rule),
-    )
+        src_lines = src.splitlines()
+        project.add_module(rel, tree, src_lines)
+        parsed.append((rel, tree, src_lines))
+
+    for rel, tree, _src_lines in parsed:
+        for rule in _rules.RULES:
+            if not rule.applies_to(rel):
+                continue
+            found.extend(rule.check(tree, rel))
+
+    if parsed:
+        from . import lockgraph as _lockgraph
+        from . import taint as _taint
+
+        found.extend(_lockgraph.check_project(project))
+        found.extend(_taint.check_project(project))
+
+    if audit_waivers:
+        fired = {(v.path, v.rule, v.line) for v in found}
+        found.extend(_audit_waivers(parsed, fired))
+
+    waived = {rel: _suppressed_lines(sl) for rel, _t, sl in parsed}
+    kept = [
+        v
+        for v in found
+        if v.rule not in waived.get(v.path, {}).get(v.line, ())
+    ]
+    return sorted(kept, key=lambda v: (v.path, v.line, v.rule))
+
+
+def analyze_file(
+    path: str,
+    relpath: Optional[str] = None,
+    audit_waivers: bool = False,
+) -> list[Violation]:
+    """All un-suppressed violations in one source file (the file is its
+    own single-module project for the interprocedural rules).  The waiver
+    audit is off by default here — a lone file is routinely analyzed out
+    of context, where "rule doesn't fire" proves nothing."""
+    rel = (relpath or path).replace(os.sep, "/")
+    return _analyze([(path, rel)], audit_waivers)
 
 
 def _iter_py_files(root: str) -> Iterable[tuple[str, str]]:
@@ -117,12 +228,14 @@ def _iter_py_files(root: str) -> Iterable[tuple[str, str]]:
                 yield full, os.path.relpath(full, base)
 
 
-def analyze_paths(paths: Iterable[str]) -> list[Violation]:
-    found: list[Violation] = []
+def analyze_paths(
+    paths: Iterable[str], audit_waivers: bool = True
+) -> list[Violation]:
+    entries: list[tuple[str, str]] = []
     for root in paths:
         for full, rel in _iter_py_files(root):
-            found.extend(analyze_file(full, rel))
-    return sorted(found, key=lambda v: (v.path, v.line, v.rule))
+            entries.append((full, rel.replace(os.sep, "/")))
+    return _analyze(entries, audit_waivers)
 
 
 # -- baseline -----------------------------------------------------------------

@@ -41,7 +41,19 @@ _REQUESTISH = frozenset(
     }
 )
 
-_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+_LOCK_FACTORIES = frozenset(
+    {
+        "Lock",
+        "RLock",
+        "Condition",
+        # util/locks.py OrderedLock constructors — same semantics, plus the
+        # SWEED_LOCK_CHECK=1 runtime order sanitizer.
+        "make_lock",
+        "make_rlock",
+        "make_condition",
+        "OrderedLock",
+    }
+)
 
 
 def _terminal_name(node: ast.AST) -> Optional[str]:

@@ -46,6 +46,7 @@ from typing import Callable, Optional
 
 from ..server.http_util import http_json
 from ..util import glog
+from ..util.locks import make_lock
 
 
 class LeaderElection:
@@ -89,7 +90,13 @@ class LeaderElection:
         # would disrupt the incumbent
         self._last_beat = time.time()
         self._last_quorum = 0.0  # leader side: last majority contact
-        self._lock = threading.Lock()
+        self._lock = make_lock("LeaderElection._lock")
+        # Durable-state writer: serializes the (term, voted_for) disk
+        # writes OUTSIDE self._lock so an fsync never blocks vote/beat
+        # intake.  Never nested inside self._lock.
+        self._persist_lock = make_lock("LeaderElection._persist_lock")
+        self._persist_seq = 0  # bumped under self._lock at each snapshot
+        self._persisted_seq = 0  # highest seq on disk; under _persist_lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -110,19 +117,36 @@ class LeaderElection:
         return (time.time() - self._last_quorum) < self.lease_seconds
 
     # -- vote intake ---------------------------------------------------------
-    def _persist(self) -> None:
-        """Durable (term, voted_for) — must hit disk before the vote reply
-        leaves, or a restart could double-vote (raft's currentTerm/votedFor
-        persistence). Called with self._lock held."""
-        if not self.state_path:
+    def _snapshot_locked(self) -> tuple[int, int, Optional[str]]:
+        """Capture (seq, term, voted_for) for a durable write.  Called with
+        self._lock held; the disk write happens later, in
+        ``_persist_snapshot``, after the lock is released."""
+        self._persist_seq += 1
+        return (self._persist_seq, self.term, self.voted_for)
+
+    def _persist_snapshot(
+        self, snap: Optional[tuple[int, int, Optional[str]]]
+    ) -> None:
+        """Durable (term, voted_for) — must hit disk before the reply or
+        request that references it leaves, or a restart could double-vote
+        (raft's currentTerm/votedFor persistence).  Runs OUTSIDE
+        self._lock so the fsync never stalls vote/beat intake; the
+        sequence number makes concurrent writers safe — a slow older
+        write is skipped rather than clobbering a newer one."""
+        if snap is None or not self.state_path:
             return
-        tmp = self.state_path + ".tmp"
-        with open(tmp, "w") as f:
-            # sweedlint: ok lock-discipline called with self._lock held (see docstring)
-            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.state_path)
+        seq, term, voted_for = snap
+        with self._persist_lock:
+            if seq <= self._persisted_seq:
+                return  # a newer snapshot already reached disk
+            tmp = self.state_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"term": term, "voted_for": voted_for}, f)
+                f.flush()
+                # sweedlint: ok blocking-under-lock dedicated IO lock held only around this write, never nested in _lock
+                os.fsync(f.fileno())
+            os.replace(tmp, self.state_path)
+            self._persisted_seq = seq
 
     def _up_to_date(self, max_file_key: int, max_volume_id: int) -> bool:
         """Candidate state must not be behind the voter's: a cold-restarted
@@ -140,46 +164,54 @@ class LeaderElection:
         max_volume_id: int = 0,
         prevote: bool = False,
     ) -> dict:
-        with self._lock:
-            lease_fresh = (time.time() - self._last_beat) < self.lease_seconds
-            disruptive = (
-                lease_fresh
-                and self.leader is not None
-                and self.leader != candidate
-            )
-            if prevote:
-                # answer only — NO state change on either side: the
-                # candidate bumps its real term only after a pre-vote
-                # majority, so a flapping node can't inflate cluster terms
-                granted = (
-                    term > self.term
-                    and not disruptive
-                    and self._up_to_date(max_file_key, max_volume_id)
+        # The finally-persist runs after the lock is released and before
+        # the return value actually leaves, so every reply that reflects
+        # a term/vote mutation is durable first — without holding other
+        # vote/beat intake hostage to the fsync.
+        snap = None
+        try:
+            with self._lock:
+                lease_fresh = (time.time() - self._last_beat) < self.lease_seconds
+                disruptive = (
+                    lease_fresh
+                    and self.leader is not None
+                    and self.leader != candidate
                 )
-                return {"granted": granted, "term": self.term}
-            if term < self.term:
-                return {"granted": False, "term": self.term}
-            if disruptive:
-                # deny without adopting the term: a live leader's followers
-                # don't let an out-of-band campaigner move the term forward
-                return {"granted": False, "term": self.term}
-            if term > self.term:
-                stepping_down = self.leader == self.self_url
-                self.term = term
-                self.voted_for = None
-                self.leader = None
-                self._persist()
-                if stepping_down:
-                    glog.info("%s: saw term %d, stepping down", self.self_url, term)
-            if self.voted_for not in (None, candidate):
-                return {"granted": False, "term": self.term}
-            if not self._up_to_date(max_file_key, max_volume_id):
-                return {"granted": False, "term": self.term}
-            if self.voted_for != candidate:
-                self.voted_for = candidate
-                self._persist()
-            self._last_beat = time.time()  # defer our own candidacy
-            return {"granted": True, "term": self.term}
+                if prevote:
+                    # answer only — NO state change on either side: the
+                    # candidate bumps its real term only after a pre-vote
+                    # majority, so a flapping node can't inflate cluster terms
+                    granted = (
+                        term > self.term
+                        and not disruptive
+                        and self._up_to_date(max_file_key, max_volume_id)
+                    )
+                    return {"granted": granted, "term": self.term}
+                if term < self.term:
+                    return {"granted": False, "term": self.term}
+                if disruptive:
+                    # deny without adopting the term: a live leader's followers
+                    # don't let an out-of-band campaigner move the term forward
+                    return {"granted": False, "term": self.term}
+                if term > self.term:
+                    stepping_down = self.leader == self.self_url
+                    self.term = term
+                    self.voted_for = None
+                    self.leader = None
+                    snap = self._snapshot_locked()
+                    if stepping_down:
+                        glog.info("%s: saw term %d, stepping down", self.self_url, term)
+                if self.voted_for not in (None, candidate):
+                    return {"granted": False, "term": self.term}
+                if not self._up_to_date(max_file_key, max_volume_id):
+                    return {"granted": False, "term": self.term}
+                if self.voted_for != candidate:
+                    self.voted_for = candidate
+                    snap = self._snapshot_locked()
+                self._last_beat = time.time()  # defer our own candidacy
+                return {"granted": True, "term": self.term}
+        finally:
+            self._persist_snapshot(snap)
 
     # -- beat intake (follower side) -----------------------------------------
     def receive_beat(
@@ -189,6 +221,7 @@ class LeaderElection:
         max_file_key: int,
         max_volume_id: int = 0,
     ) -> dict:
+        snap = None
         with self._lock:
             if term < self.term:
                 return {"ok": False, "term": self.term}
@@ -203,7 +236,8 @@ class LeaderElection:
             self.leader = leader
             self._last_beat = time.time()
             if term_changed:
-                self._persist()
+                snap = self._snapshot_locked()
+        self._persist_snapshot(snap)
         if max_file_key:
             self.on_checkpoint(max_file_key)
         if max_volume_id:
@@ -257,12 +291,14 @@ class LeaderElection:
             if r.get("ok"):
                 acks += 1
             elif r.get("term", 0) > self.term:  # sweedlint: ok lock-discipline optimistic check; re-validated under the lock below
+                snap = None
                 with self._lock:
                     if r["term"] > self.term:
                         self.term = r["term"]
                         self.leader = None
                         self.voted_for = None
-                        self._persist()
+                        snap = self._snapshot_locked()
+                self._persist_snapshot(snap)
                 glog.info("%s: peer %s has term %d, stepping down",
                           self.self_url, p, r["term"])
                 return 0
@@ -291,11 +327,13 @@ class LeaderElection:
             elif r.get("term", 0) > term:
                 # adopt the observed (already-existing) cluster term so a
                 # lagging candidate catches up and can campaign next round
+                snap = None
                 with self._lock:
                     if r["term"] > self.term:
                         self.term = r["term"]
                         self.voted_for = None
-                        self._persist()
+                        snap = self._snapshot_locked()
+                self._persist_snapshot(snap)
                 return None
         return votes
 
@@ -314,7 +352,10 @@ class LeaderElection:
             self.term = proposed
             term = self.term
             self.voted_for = self.self_url
-            self._persist()
+            snap = self._snapshot_locked()
+        # durable before the first vote request leaves: a crash between
+        # voting for self and soliciting peers must not forget the term
+        self._persist_snapshot(snap)
         votes = self._collect_votes(term, prevote=False)
         if votes is None:
             return

@@ -25,6 +25,7 @@ from .sequence import MemorySequencer
 from .topology import DataNode, Topology
 from .volume_growth import VolumeGrowOption, VolumeGrowth
 from .volume_layout import NoWritableVolumesError
+from ..util.locks import make_condition, make_rlock
 
 
 @dataclass
@@ -62,12 +63,12 @@ class Master:
         self._admin_lock_token: Optional[str] = None
         self._admin_lock_ts = 0.0
         self._admin_lock_client = ""
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Master._lock")
         # versioned VolumeLocation delta log for remote KeepConnected
         # subscribers (wdclient long-polls /cluster/watch against this)
         self._loc_version = 0
         self._loc_log: deque = deque(maxlen=4096)
-        self._loc_cond = threading.Condition(self._lock)
+        self._loc_cond = make_condition(self._lock)
 
     @staticmethod
     def _reject_allocate(dn, vid, option):

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import threading
+from ..util.locks import make_lock
 
 
 class MemorySequencer:
@@ -12,7 +13,7 @@ class MemorySequencer:
 
     def __init__(self, start: int = 1):
         self._counter = max(1, start)
-        self._lock = threading.Lock()
+        self._lock = make_lock("MemorySequencer._lock")
 
     def next_file_id(self, count: int = 1) -> int:
         with self._lock:
@@ -52,7 +53,7 @@ class EtcdSequencer:
             ) from e
         host, _, port = endpoint.partition(":")
         self._c = etcd3.client(host=host, port=int(port or 2379))
-        self._lock = threading.Lock()
+        self._lock = make_lock("EtcdSequencer._lock")
         self._next = 0   # local cursor within the reserved batch
         self._ceiling = 0
 

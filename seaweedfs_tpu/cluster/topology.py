@@ -15,6 +15,7 @@ from typing import Callable, Optional
 
 from ..storage.replica_placement import ReplicaPlacement
 from ..storage.ttl import TTL
+from ..util.locks import make_rlock
 
 
 @dataclass
@@ -197,7 +198,7 @@ class Topology(Node):
     def __init__(self, volume_size_limit: int = 30 * 1024 * 1024 * 1024):
         super().__init__("topo")
         self.volume_size_limit = volume_size_limit
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Topology._lock")
         # (collection, rp_str, ttl_str) → VolumeLayout
         from .volume_layout import VolumeLayout
 

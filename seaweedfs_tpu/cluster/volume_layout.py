@@ -13,6 +13,7 @@ from typing import TYPE_CHECKING, Optional
 
 from ..storage.replica_placement import ReplicaPlacement
 from ..storage.ttl import TTL
+from ..util.locks import make_rlock
 
 if TYPE_CHECKING:
     from .topology import DataNode, VolumeInfo
@@ -32,7 +33,7 @@ class VolumeLayout:
         self.writables: list[int] = []
         self.readonly_volumes: set[int] = set()
         self.oversized_volumes: set[int] = set()
-        self._lock = threading.RLock()
+        self._lock = make_rlock("VolumeLayout._lock")
 
     # -- registration (volume_layout.go:104-200) -----------------------------
     def register_volume(self, vi: "VolumeInfo", dn: "DataNode") -> None:

@@ -16,6 +16,7 @@ from .entry import Entry, FileChunk
 from .filechunks import compact_file_chunks, minus_chunks
 from .filerstore import FilerStore, MemoryStore, NotFoundError
 from .meta_log import MetaLog
+from ..util.locks import make_rlock
 
 # purge(fids) — wired to operation.delete_files by the daemon
 ChunkPurger = Callable[[list[str]], None]
@@ -36,7 +37,7 @@ class Filer:
         # (filer_delete_entry.go ResolveChunkManifest); the server wires a
         # resolver that can actually read manifest blobs
         self.chunk_resolver: Optional[Callable[[list], list]] = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Filer._lock")
         self._ensure_root()
 
     def _fids(self, chunks) -> list[str]:

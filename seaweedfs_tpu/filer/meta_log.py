@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..util import glog
+from ..util.locks import make_condition, make_lock
 
 _SEG_PREFIX = "seg-"
 _SEG_SUFFIX = ".jsonl"
@@ -78,8 +79,8 @@ class MetaLog:
         self.persist_dir = persist_dir
         self.segment_events = segment_events
         self._events: list[EventNotification] = []
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = make_lock("MetaLog._lock")
+        self._cond = make_condition(self._lock)
         self._subscribers: dict[str, Callable[[EventNotification], None]] = {}
         self._next_seq = 1
         self._last_ts_ns = 0

@@ -14,6 +14,7 @@ from ..filer.client import FilerClient
 from ..server.http_util import JsonHandler, start_server
 from ..util.parsers import tolerant_uint
 from .log_buffer import LogBuffer, decode_messages
+from ..util.locks import make_lock
 
 TOPICS_ROOT = "/topics"
 
@@ -83,7 +84,7 @@ class TopicManager:
         self.client = FilerClient(filer_url)
         self._partitions: dict[tuple, TopicPartition] = {}
         self._dead: set[tuple[str, str]] = set()  # tombstones until recreate
-        self._lock = threading.Lock()
+        self._lock = make_lock("TopicManager._lock")
 
     def conf_path(self, ns: str, topic: str) -> str:
         return f"{TOPICS_ROOT}/{ns}/{topic}/.conf"

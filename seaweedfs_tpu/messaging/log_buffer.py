@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 import time
 from typing import Callable, Optional
+from ..util.locks import make_lock
 
 # flush_fn(start_ts_ns, stop_ts_ns, encoded_segment_bytes)
 FlushFn = Callable[[int, int, bytes], None]
@@ -60,7 +61,7 @@ class LogBuffer:
         self._msgs: list[tuple[int, bytes, bytes]] = []
         self._start_ts = 0
         self._prev: list[list[tuple[int, bytes, bytes]]] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("LogBuffer._lock")
         self._flushers: list[threading.Thread] = []
         self._last_flush = time.monotonic()
         self._stop = threading.Event()

@@ -33,6 +33,7 @@ import time
 import urllib.parse
 from typing import Optional
 
+from ..util.locks import lock_stats, make_lock
 from .. import operation
 from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import MAX_INT64, view_from_chunks
@@ -96,7 +97,7 @@ class _FidBatch:
         self._batch = max(1, batch)
         self._lanes = max(1, lanes)
         self._pending: list[operation.Assignment] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("_FidBatch._lock")
 
     def _one_batch(self) -> list[operation.Assignment]:
         a = operation.assign(
@@ -124,17 +125,25 @@ class _FidBatch:
 
     def next(self) -> operation.Assignment:
         with self._lock:
-            if not self._pending:
-                lanes = [self._one_batch() for _ in range(self._lanes)]
-                # round-robin deal so neighboring pieces hit distinct
-                # volumes; .pop() serves from the end, so build reversed
-                dealt = [
-                    lane[i]
-                    for i in range(max(len(ln) for ln in lanes))
-                    for lane in lanes
-                    if i < len(lane)
-                ]
-                self._pending = dealt[::-1]
+            if self._pending:
+                return self._pending.pop()
+        # Refill OUTSIDE the lock: operation.assign is a master RPC, and
+        # holding _lock across it would stall every concurrent upload
+        # that still has fids in hand.  Two threads racing here both
+        # allocate a batch; both batches are kept — fids are cheap and
+        # an unused one is simply never written.
+        lanes = [self._one_batch() for _ in range(self._lanes)]
+        # round-robin deal so neighboring pieces hit distinct
+        # volumes; .pop() serves from the end, so build reversed
+        dealt = [
+            lane[i]
+            for i in range(max(len(ln) for ln in lanes))
+            for lane in lanes
+            if i < len(lane)
+        ]
+        with self._lock:
+            # keep whatever arrived meanwhile; older fids serve first
+            self._pending = dealt[::-1] + self._pending
             return self._pending.pop()
 
 
@@ -379,6 +388,9 @@ class FilerServer:
                 "read_window": self.read_window,
                 "write_window": self.write_window,
             },
+            # OrderedLock sanitizer counters + observed order edges
+            # (all-zero unless the process runs with SWEED_LOCK_CHECK=1)
+            "locks": lock_stats(),
         }
 
     def _h_metrics(self, h, path, q, body):
@@ -895,6 +907,12 @@ class FilerServer:
         Whole chunks are fetched and sliced (the reference issues ranged
         chunk GETs — a volume-server Range feature to add); volume lookups
         are cached to keep master round-trips off the read path."""
+        # clamp to the entry's real extent: offset/size trace back to
+        # request ranges, and the allocation below must never exceed what
+        # the entry can actually hold
+        total = entry.file_size()
+        offset = max(0, min(offset, total))
+        size = max(0, min(size, total - offset))
         views = view_from_chunks(self._resolve_chunks(entry.chunks), offset, size)
         out = bytearray(size)
         decrypted: dict[str, bytes] = {}  # per-call memo; cache stays ciphertext

@@ -24,6 +24,7 @@ from ..cluster.topology import DataNode
 from ..util import glog
 from ..util.parsers import tolerant_ufloat, tolerant_uint
 from .http_util import JsonHandler, http_json, start_server
+from ..util.locks import lock_stats, make_lock
 
 
 class MasterServer:
@@ -52,7 +53,7 @@ class MasterServer:
         )
         self.node_timeout = node_timeout
         self._nodes: dict[str, DataNode] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("MasterServer._lock")
         self._srv = None
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -247,6 +248,9 @@ class MasterServer:
             "is_leader": self.election.is_leader,
             "term": self.election.term,
             "topology": self.master.topology_info(),
+            # OrderedLock sanitizer counters + observed order edges
+            # (all-zero unless the process runs with SWEED_LOCK_CHECK=1)
+            "locks": lock_stats(),
         }
 
     def _h_ui(self, h, path, q, body):

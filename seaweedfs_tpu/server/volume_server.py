@@ -854,6 +854,7 @@ class VolumeServer:
             v.super_block.replica_placement = rp
             try:
                 v.data_backend.write_at(0, v.super_block.to_bytes())
+                # sweedlint: ok blocking-under-lock persist-or-nothing placement write; fsync under the volume lock is the point
                 v.data_backend.sync()
             except Exception:
                 # persist-or-nothing: a failed write must not leave memory

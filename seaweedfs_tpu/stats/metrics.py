@@ -167,6 +167,35 @@ class Registry:
 default_registry = Registry()
 
 
+def register_lock_metrics(registry: Optional[Registry] = None) -> None:
+    """Gauges over the OrderedLock sanitizer's counters (util/locks.py):
+    total acquisitions, contended acquires, deepest held-while-acquiring
+    nesting, and the observed order-graph edge count.  All zero unless
+    the process runs with SWEED_LOCK_CHECK=1."""
+    from ..util.locks import lock_stats
+
+    reg = registry if registry is not None else default_registry
+    reg.gauge(
+        "sweed_lock_acquisitions_total",
+        "instrumented lock acquisitions (SWEED_LOCK_CHECK=1)",
+    ).set_function(lambda: lock_stats()["acquisitions"])
+    reg.gauge(
+        "sweed_lock_contended_total",
+        "acquires that found the lock held",
+    ).set_function(lambda: lock_stats()["contended"])
+    reg.gauge(
+        "sweed_lock_max_held_depth",
+        "deepest held-while-acquiring nesting observed",
+    ).set_function(lambda: lock_stats()["max_held_depth"])
+    reg.gauge(
+        "sweed_lock_order_edges",
+        "distinct observed lock-order edges",
+    ).set_function(lambda: len(lock_stats()["edges"]))
+
+
+register_lock_metrics()
+
+
 # -- host probes (stats/disk.go, memory.go) ----------------------------------
 def disk_status(path: str) -> dict:
     st = os.statvfs(path)

@@ -12,6 +12,7 @@ import os
 import threading
 
 from ..util.parsers import tolerant_uint
+from ..util.locks import make_lock
 
 
 class BackendStorageFile:
@@ -55,7 +56,7 @@ class DiskFile(BackendStorageFile):
         # Go's os.File — a kill -9 must not lose acknowledged needles
         # (durability against power loss still needs fsync=true / sync())
         self._f = open(path, mode, buffering=0)
-        self._lock = threading.Lock()
+        self._lock = make_lock("DiskFile._lock")
 
     def read_at(self, offset: int, size: int) -> bytes:
         # raw FileIO read/write are single syscalls and may be partial —
@@ -101,6 +102,7 @@ class DiskFile(BackendStorageFile):
     def sync(self) -> None:
         with self._lock:
             self._f.flush()
+            # sweedlint: ok blocking-under-lock per-fd leaf lock serializing write+fsync; nothing nests inside it
             os.fsync(self._f.fileno())
 
     def close(self) -> None:
@@ -116,7 +118,7 @@ class MemoryFile(BackendStorageFile):
     def __init__(self, name: str = "<memory>"):
         self._buf = bytearray()
         self._name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("MemoryFile._lock")
 
     def read_at(self, offset: int, size: int) -> bytes:
         with self._lock:

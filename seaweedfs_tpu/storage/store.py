@@ -29,6 +29,7 @@ from .needle import Needle
 from .replica_placement import ReplicaPlacement
 from .ttl import EMPTY_TTL, TTL, read_ttl
 from .volume import NotFoundError, Volume
+from ..util.locks import make_rlock
 
 # remote_reader(vid, shard_id, offset, size) -> bytes | None
 RemoteShardReader = Callable[[int, int, int, int], Optional[bytes]]
@@ -80,7 +81,7 @@ class Store:
         self.new_ec_shards: deque[dict] = deque()
         self.deleted_ec_shards: deque[dict] = deque()
         self.delta_event = threading.Event()
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Store._lock")
 
     @property
     def ec_codec(self) -> Codec:

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
+from ..util.locks import make_rlock
 from ..util import faultpoints
 from ..util.parsers import tolerant_uint
 from .backend import BackendStorageFile, DiskFile
@@ -94,7 +95,7 @@ class Volume:
         self._read_only = False
         self.last_append_at_ns = 0
         self.last_modified_ts_seconds = 0
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Volume._lock")
         self._is_compacting = False
 
         base = self.file_name()
@@ -218,7 +219,7 @@ class Volume:
 
         base = self.file_name()
         with self._lock:
-            self.sync()
+            self.sync()  # sweedlint: ok blocking-under-lock flush-before-handoff; the native engine must see a complete .dat
             if not engine.register(
                 self.id, base + ".dat", base + ".idx", self.version,
                 self.offset_size, writable_http, self._read_only,
@@ -469,6 +470,7 @@ class Volume:
             if self.last_modified_ts_seconds < n.last_modified:
                 self.last_modified_ts_seconds = n.last_modified
             if fsync:
+                # sweedlint: ok blocking-under-lock write→fsync→ack ordering under the lock IS the durability contract (docs/CRASH.md)
                 self.sync()
             return offset, n.size, False
 
@@ -642,12 +644,14 @@ class Volume:
             was_read_only = self.read_only
             self.read_only = True
             try:
+                # sweedlint: ok blocking-under-lock seal point: the upload snapshot must include every acked write
                 self.data_backend.sync()
                 key = f"{self.collection or 'default'}_{self.id}.dat"
                 size = self.data_backend.size()
                 local = self.file_name() + ".dat"
                 client = S3Client(endpoint, access_key, secret_key)
                 if skip_upload:
+                    # sweedlint: ok blocking-under-lock admin-plane tier move on a sealed volume; the held lock is the exclusivity the backend swap needs
                     status, _, headers = client.head_object(bucket, key)
                     if status != 200:
                         raise VolumeError(
@@ -662,8 +666,10 @@ class Volume:
                             f"tier object size {remote_size} != local {size}"
                         )
                 else:
+                    # sweedlint: ok blocking-under-lock admin-plane tier move on a sealed volume; the held lock is the exclusivity the backend swap needs
                     client.create_bucket(bucket)  # idempotent-ish; 409 is fine
                     # bounded memory: multipart for anything past one part
+                    # sweedlint: ok blocking-under-lock admin-plane tier move on a sealed volume; the held lock is the exclusivity the backend swap needs
                     status = client.put_object_from_file(bucket, key, local)
                     if status != 200:
                         raise VolumeError(f"tier upload failed: HTTP {status}")
@@ -694,10 +700,14 @@ class Volume:
             # state (no descriptor, .dat intact) or the new one exists
             from .commit import atomic_write
 
+            # sweedlint: ok blocking-under-lock descriptor commit point must exclude writers; faultpoint sleeps are test-only
             faultpoints.fire("tier.upload.descriptor", path=local)
+            # sweedlint: ok blocking-under-lock descriptor commit point must exclude writers (docs/CRASH.md)
             atomic_write(tf, _json.dumps(info).encode(), mode=0o600)
+            # sweedlint: ok blocking-under-lock descriptor commit point must exclude writers; faultpoint sleeps are test-only
             faultpoints.fire("tier.upload.committed", path=tf)
             self.data_backend.close()
+            # sweedlint: ok blocking-under-lock admin-plane tier move on a sealed volume; the held lock is the exclusivity the backend swap needs
             self.data_backend = RemoteS3File(
                 endpoint, bucket, key, access_key, secret_key, size=size
             )
@@ -738,14 +748,17 @@ class Volume:
             sc.remove_on_commit(self.tier_file())
             try:
                 # ranged-GET pages straight to disk: no whole-volume buffer
+                # sweedlint: ok blocking-under-lock admin-plane tier move on a sealed volume; the held lock is the exclusivity the backend swap needs
                 got = client.get_object_to_file(
                     info["bucket"], info["key"], tmp
                 )
+                # sweedlint: ok blocking-under-lock descriptor commit point must exclude writers; faultpoint sleeps are test-only
                 faultpoints.fire("tier.download.fetched", path=tmp)
                 if got != info["size"]:
                     raise VolumeError(
                         f"tier download: got {got} bytes, want {info['size']}"
                     )
+                # sweedlint: ok blocking-under-lock two-phase commit point; exclusivity is the crash-safety contract
                 sc.commit()
             except Exception:
                 sc.abort()
@@ -792,6 +805,7 @@ class Volume:
         version = self.version
         try:
             with self._lock:
+                # sweedlint: ok blocking-under-lock snapshot point: the sizes below are only meaningful after a flush
                 self.sync()
                 snap_dat = self.data_backend.size()
                 snap_idx = self.nm.index_file_size()
@@ -856,6 +870,7 @@ class Volume:
                 # phase 3 (locked): makeupDiff — replay .idx entries
                 # appended during phases 1-2, then swap
                 with self._lock:
+                    # sweedlint: ok blocking-under-lock makeupDiff snapshot: the .idx tail must be flushed before replay; writers are excluded on purpose
                     self.sync()
                     end_idx = self.nm.index_file_size()
                     if end_idx > snap_idx:
@@ -900,6 +915,7 @@ class Volume:
                     # is then a no-op
                     dst.close()
                     dst_idx.close()
+                    # sweedlint: ok blocking-under-lock compact commit swaps .dat/.idx and must exclude writers (docs/CRASH.md); faultpoint sleeps are test-only
                     self._commit_compact(base)
         finally:
             with self._lock:
@@ -925,6 +941,7 @@ class Volume:
         sc = StagedCommit(base, "vacuum")
         sc.stage(base + ".dat", tmp_path=base + ".cpd")
         sc.stage(base + ".idx", tmp_path=base + ".cpx")
+        # sweedlint: ok blocking-under-lock compact commit swaps .dat/.idx; it must exclude writers (docs/CRASH.md)
         sc.commit()
         self.data_backend = DiskFile(base + ".dat")
         import struct as _struct
@@ -947,6 +964,7 @@ class Volume:
             if self.turbo is not None:
                 self.turbo.sync(self.id)
                 return
+            # sweedlint: ok blocking-under-lock Volume.sync IS the durability primitive; callers hold the lock for write→fsync→ack ordering
             self.data_backend.sync()
             self.nm.sync()
 

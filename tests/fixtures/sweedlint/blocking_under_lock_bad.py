@@ -1,0 +1,13 @@
+"""Fixture: time.sleep while holding the lock — blocking-under-lock must
+fire exactly once, at the sleep call."""
+import threading
+import time
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self):
+        with self._lock:
+            time.sleep(0.01)

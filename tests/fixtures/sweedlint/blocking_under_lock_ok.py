@@ -1,0 +1,14 @@
+"""Fixture: same sleep-under-lock as blocking_under_lock_bad.py, waived
+with a reason — sweedlint must report nothing."""
+import threading
+import time
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self):
+        with self._lock:
+            # sweedlint: ok blocking-under-lock fixture: deliberate pause, lock is private to this class
+            time.sleep(0.01)

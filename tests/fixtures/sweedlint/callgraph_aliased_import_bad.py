@@ -1,0 +1,13 @@
+"""Fixture: time.sleep imported under an alias — symbol resolution must
+still classify the call as blocking; fires exactly once."""
+import threading
+from time import sleep as snooze
+
+
+class Throttle:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def tick(self):
+        with self._lock:
+            snooze(0.01)

@@ -1,0 +1,27 @@
+"""Fixture: the blocking callee is decorated — the call graph must
+resolve through the decorator; blocking-under-lock fires exactly once, at
+the call site."""
+import functools
+import threading
+import time
+
+
+def traced(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        return fn(*a, **k)
+
+    return wrapper
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    @traced
+    def drain(self):
+        time.sleep(0.01)
+
+    def run(self):
+        with self._lock:
+            self.drain()

@@ -1,0 +1,19 @@
+"""Fixture: the blocking call lives in an inherited method — call
+resolution through the MRO must still find it; blocking-under-lock fires
+exactly once, at the call site in the subclass."""
+import threading
+import time
+
+
+class Base:
+    def drain(self):
+        time.sleep(0.01)
+
+
+class Child(Base):
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def run(self):
+        with self._lock:
+            self.drain()

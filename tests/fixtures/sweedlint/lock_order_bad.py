@@ -1,0 +1,20 @@
+"""Fixture: transfer() takes _a then _b, rebalance() takes _b then _a —
+a two-lock cycle; lock-order must fire exactly once, anchored at the
+lexically-first edge site (the inner acquisition in transfer())."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def transfer(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def rebalance(self):
+        with self._b:
+            with self._a:
+                pass

@@ -1,0 +1,20 @@
+"""Fixture: same ABBA cycle as lock_order_bad.py, waived at the anchor
+site with a reason — sweedlint must report nothing."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def transfer(self):
+        with self._a:
+            # sweedlint: ok lock-order fixture: rebalance is startup-only and never concurrent with transfer
+            with self._b:
+                pass
+
+    def rebalance(self):
+        with self._b:
+            with self._a:
+                pass

@@ -1,0 +1,18 @@
+"""Fixture: the *_locked convention — _flush_locked is analyzed as
+running with its class's lock held and waives its blocking call at the
+precise site; the caller must NOT re-report it.  Zero findings."""
+import threading
+import time
+
+
+class Buffered:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _flush_locked(self):
+        # sweedlint: ok blocking-under-lock fixture: deliberate pause inside the locked section
+        time.sleep(0.01)
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()

@@ -1,0 +1,13 @@
+"""Fixture: a reasoned waiver for a rule that no longer fires at that
+site — the stale-waiver audit must flag it exactly once."""
+import threading
+
+
+class Quiet:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self):
+        with self._lock:
+            # sweedlint: ok blocking-under-lock the sleep was removed in a refactor
+            return 1

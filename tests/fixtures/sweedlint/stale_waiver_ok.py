@@ -1,0 +1,14 @@
+"""Fixture: a live waiver — the rule it names still fires on the next
+line, so the audit must stay silent (and the waiver suppresses it)."""
+import threading
+import time
+
+
+class Quiet:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self):
+        with self._lock:
+            # sweedlint: ok blocking-under-lock fixture: deliberate pause, lock is private to this class
+            time.sleep(0.01)

@@ -1,0 +1,8 @@
+"""Fixture: Content-Length straight off the wire into .read() — tainted-size
+must fire exactly once, at the read call."""
+
+
+class Handler:
+    def serve(self, headers, body):
+        n = headers.get("Content-Length")
+        return body.read(n)

@@ -1,0 +1,16 @@
+"""Fixture: the same wire-derived size, once routed through the tolerant
+parser (the sanctioned fix) and once waived — sweedlint must report
+nothing."""
+
+from seaweedfs_tpu.util.parsers import tolerant_uint
+
+
+class Handler:
+    def serve(self, headers, body):
+        n = tolerant_uint(headers.get("Content-Length"), 0)
+        return body.read(n)
+
+    def serve_raw(self, headers, body):
+        n = headers.get("Content-Length")
+        # sweedlint: ok tainted-size fixture: n is bounds-checked by the caller
+        return body.read(n)

@@ -1,0 +1,240 @@
+"""OrderedLock runtime sanitizer (util/locks.py) + the static ⊇ dynamic
+cross-check against the lock graph computed by analysis/lockgraph.py.
+
+The unit tests construct OrderedLock directly (the wrapper always
+records; only the make_* factories consult SWEED_LOCK_CHECK).  The
+cross-check runs real concurrency suites in a subprocess under
+SWEED_LOCK_CHECK=1 with SWEED_LOCK_DUMP, then asserts every dynamically
+observed acquisition edge appears in the statically computed graph — if
+it doesn't, either the call-graph resolution lost a path (fix
+analysis/callgraph.py) or a lock was created outside the make_* naming
+contract (fix the product code).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from seaweedfs_tpu.util.locks import (
+    LockOrderError,
+    OrderedLock,
+    lock_stats,
+    make_condition,
+    make_lock,
+    make_rlock,
+    observed_edges,
+    reset_observed,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PACKAGE = os.path.join(REPO, "seaweedfs_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    reset_observed()
+    yield
+    reset_observed()
+
+
+# -- unit: ordering -----------------------------------------------------------
+
+def test_inversion_raises_before_blocking():
+    a = OrderedLock("A._lock")
+    b = OrderedLock("B._lock")
+    with a:
+        with b:
+            pass
+    # opposite order: must raise even though nothing would deadlock here
+    with b:
+        with pytest.raises(LockOrderError):
+            a.acquire()
+
+
+def test_consistent_order_is_silent():
+    a = OrderedLock("A._lock")
+    b = OrderedLock("B._lock")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert observed_edges() == [("A._lock", "B._lock")]
+
+
+def test_same_name_edges_not_recorded():
+    """Two instances of the same class share a node: per-class
+    granularity, no self-edge."""
+    v1 = OrderedLock("Volume._lock")
+    v2 = OrderedLock("Volume._lock")
+    with v1:
+        with v2:
+            pass
+    assert observed_edges() == []
+
+
+def test_transitive_cycle_detected():
+    a, b, c = (OrderedLock(n) for n in ("A._lock", "B._lock", "C._lock"))
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(LockOrderError):
+            a.acquire()
+
+
+def test_rlock_reentrancy_is_not_an_edge():
+    r = OrderedLock("R._lock", "rlock")
+    with r:
+        with r:
+            assert r.locked()
+    assert observed_edges() == []
+    assert not r.locked()
+
+
+def test_nonblocking_acquire_failure_keeps_stack_clean():
+    lk = OrderedLock("X._lock")
+    lk.acquire()
+    result = {}
+
+    def try_it():
+        result["got"] = lk.acquire(blocking=False)
+
+    t = threading.Thread(target=try_it)
+    t.start()
+    t.join()
+    assert result["got"] is False
+    lk.release()
+    # the failed acquire must not have polluted the other thread's stack
+    # or the registry
+    assert lock_stats()["per_lock"]["X._lock"]["contended"] == 1
+
+
+def test_condition_wait_releases_and_restores():
+    lk = OrderedLock("MetaLog._lock")
+    cond = threading.Condition(lk)
+    ready = threading.Event()
+    done = []
+
+    def waiter():
+        with cond:
+            ready.set()
+            cond.wait(timeout=5)
+            # after wait() the lock is held again: this nested acquire
+            # must register an edge from MetaLog._lock
+            with OrderedLock("Leaf._lock"):
+                done.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    ready.wait(5)
+    with cond:
+        cond.notify()
+    t.join(5)
+    assert done == [True]
+    assert ("MetaLog._lock", "Leaf._lock") in observed_edges()
+
+
+def test_stats_counters():
+    a = OrderedLock("A._lock")
+    b = OrderedLock("B._lock")
+    with a:
+        with b:
+            pass
+    s = lock_stats()
+    assert s["acquisitions"] == 2
+    assert s["max_held_depth"] == 2
+    assert s["per_lock"]["A._lock"]["acquisitions"] == 1
+
+
+def test_factories_return_plain_locks_when_disabled(monkeypatch):
+    monkeypatch.delenv("SWEED_LOCK_CHECK", raising=False)
+    assert not isinstance(make_lock("A._lock"), OrderedLock)
+    assert not isinstance(make_rlock("A._lock"), OrderedLock)
+
+
+def test_factories_return_ordered_locks_when_enabled(monkeypatch):
+    monkeypatch.setenv("SWEED_LOCK_CHECK", "1")
+    lk = make_lock("A._lock")
+    assert isinstance(lk, OrderedLock)
+    assert isinstance(make_rlock("B._lock"), OrderedLock)
+    cond = make_condition(lk)
+    assert isinstance(cond, threading.Condition)
+
+
+# -- cross-check: static ⊇ dynamic --------------------------------------------
+
+def _static_edges() -> set[tuple[str, str]]:
+    from seaweedfs_tpu.analysis import _iter_py_files
+    from seaweedfs_tpu.analysis.callgraph import Project
+    from seaweedfs_tpu.analysis.lockgraph import compute_lock_graph
+
+    proj = Project()
+    for path, rel in _iter_py_files(PACKAGE):
+        src = open(path, encoding="utf-8").read()
+        proj.add_module(rel, ast.parse(src), src.splitlines())
+    return compute_lock_graph(proj).edge_set()
+
+
+def test_concurrency_suites_under_sanitizer_cross_check(tmp_path):
+    """Run the real concurrency suites with SWEED_LOCK_CHECK=1: zero
+    inversions (a LockOrderError fails the suite) and every observed
+    edge present in the static graph."""
+    dump = tmp_path / "lockdump.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SWEED_LOCK_CHECK="1",
+        SWEED_LOCK_DUMP=str(dump),
+    )
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_concurrent_vacuum.py",
+            "tests/test_election_quorum.py",
+            "tests/test_messaging.py",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO,
+        timeout=600,
+    )
+    assert r.returncode == 0, (
+        "concurrency suites failed under SWEED_LOCK_CHECK=1 "
+        "(lock-order inversion?):\n" + r.stdout[-4000:] + r.stderr[-2000:]
+    )
+    assert dump.exists(), "sanitizer wrote no dump — OrderedLock inactive?"
+    snap = json.loads(dump.read_text())
+    assert snap["enabled"] is True
+    assert snap["acquisitions"] > 0, "no instrumented acquisitions recorded"
+
+    dynamic = set()
+    for e in snap["edges"]:
+        a, _, b = e.partition(" -> ")
+        dynamic.add((a, b))
+    assert dynamic, "no lock nesting observed — suites too shallow?"
+
+    static = _static_edges()
+    missing = dynamic - static
+    assert not missing, (
+        "dynamically observed lock-order edges missing from the static "
+        f"graph (call-graph resolution gap): {sorted(missing)}\n"
+        f"first sites: "
+        f"{ {k: v for k, v in snap.get('edge_sites', {}).items() if tuple(k.split(' -> ')) in missing} }"
+    )

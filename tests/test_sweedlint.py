@@ -32,6 +32,10 @@ CASES = [
     ("broad-except", "broad_except", "server/fixture.py"),
     ("resource-leak", "resource_leak", "server/fixture.py"),
     ("bounded-window", "bounded_window", "server/fixture.py"),
+    # interprocedural rules (analysis/lockgraph.py, analysis/taint.py)
+    ("lock-order", "lock_order", "cluster/fixture.py"),
+    ("blocking-under-lock", "blocking_under_lock", "storage/fixture.py"),
+    ("tainted-size", "tainted_size", "server/fixture.py"),
 ]
 
 
@@ -73,6 +77,68 @@ def test_reasonless_suppression_does_not_count(tmp_path):
     assert [v.rule for v in found] == ["broad-except"], found
 
 
+# -- call-graph corner cases (interprocedural resolution) ---------------------
+
+CORNER_CASES = [
+    ("callgraph_inherited", "inherited method found through the MRO"),
+    ("callgraph_decorated", "decorated callee still resolves"),
+    ("callgraph_aliased_import", "aliased `from time import sleep`"),
+]
+
+
+@pytest.mark.parametrize(
+    "stem,why", CORNER_CASES, ids=[c[0] for c in CORNER_CASES]
+)
+def test_callgraph_corner_case_fires_exactly_once(stem, why):
+    found = analyze_file(
+        os.path.join(FIXTURES, f"{stem}_bad.py"), "storage/fixture.py"
+    )
+    assert [v.rule for v in found] == ["blocking-under-lock"], (why, found)
+
+
+def test_locked_suffix_callee_reports_only_at_its_own_site():
+    """A ``*_locked`` callee is analyzed as lock-holding itself; its waived
+    blocking call must not be re-reported at the caller."""
+    found = analyze_file(
+        os.path.join(FIXTURES, "locked_suffix_ok.py"), "storage/fixture.py"
+    )
+    assert found == [], found
+
+
+# -- stale-waiver audit --------------------------------------------------------
+
+def test_stale_waiver_fires_on_dead_suppression():
+    found = analyze_file(
+        os.path.join(FIXTURES, "stale_waiver_bad.py"),
+        "storage/fixture.py",
+        audit_waivers=True,
+    )
+    assert [v.rule for v in found] == ["stale-waiver"], found
+
+
+def test_live_waiver_passes_the_audit():
+    found = analyze_file(
+        os.path.join(FIXTURES, "stale_waiver_ok.py"),
+        "storage/fixture.py",
+        audit_waivers=True,
+    )
+    assert found == [], found
+
+
+def test_analyze_paths_audits_waivers(tmp_path):
+    """The project-level entry point (the gate, the CLI) always runs the
+    waiver audit — a dead `sweedlint: ok` comment is a finding."""
+    d = tmp_path / "storage"
+    d.mkdir()
+    (d / "thing.py").write_text(
+        "def f(x):\n"
+        "    # sweedlint: ok durability nothing here ever renamed anything\n"
+        "    return x\n"
+    )
+    found = analyze_paths([str(d)])
+    assert [v.rule for v in found] == ["stale-waiver"], found
+
+
 def test_gate_package_is_clean_against_baseline():
     """Tier-1 gate: no new violations anywhere in seaweedfs_tpu/, and no
     baseline entry that stopped firing (stale waivers must be deleted)."""
@@ -111,6 +177,51 @@ def test_cli_exit_codes(tmp_path):
     (good / "thing.py").write_text("x = 1\n")
     r = subprocess.run(
         [sys.executable, "-m", "seaweedfs_tpu.analysis", str(good)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(PACKAGE),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_sarif_output(tmp_path):
+    """--sarif emits a SARIF 2.1.0 run with one result per violation; the
+    exit code still reflects the findings."""
+    import json
+    import subprocess
+    import sys
+
+    bad = tmp_path / "storage"
+    bad.mkdir()
+    (bad / "thing.py").write_text(
+        "import os\n\ndef f(b):\n    os.replace(b + '.cpd', b + '.dat')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", "--sarif", str(bad)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(PACKAGE),
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "sweedlint"
+    results = run["results"]
+    assert [res["ruleId"] for res in results] == ["durability"]
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("thing.py")
+    assert loc["region"]["startLine"] == 4
+
+
+def test_cli_changed_mode_smoke():
+    """--changed HEAD analyzes the diff against HEAD — empty by
+    construction, so the run is clean regardless of the working tree."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", "--changed", "HEAD"],
         capture_output=True, text=True, env=env,
         cwd=os.path.dirname(PACKAGE),
     )
