@@ -723,6 +723,82 @@ def probe_extras(sweep_guard_s: float = 240.0) -> None:
     print(json.dumps(out))
 
 
+def probe_query(size_mb: int = 256) -> None:
+    """Child mode: vectorized S3-Select scan (query/scan.py) vs the
+    pure-Python row-at-a-time engine on a >=size_mb CSV. Prints one JSON
+    line with per-backend times, speedups, and a byte-identity verdict.
+
+    Runs on CPU XLA regardless of the parent's device: the scan kernels
+    are host-side and gather-heavy, and staging the whole CSV through
+    this dev host's ~100 MB/s tunnel every repetition would measure the
+    tunnel, not the kernels (same reasoning as the encode probes' on-
+    device generation, inverted).
+
+    Warm-up runs the FULL input once per backend before timing: the jit
+    backend compiles one kernel per pow2 row-batch bucket, and a warm
+    pass that misses a bucket leaves its compile inside the measured run
+    (observed as an apparent 2x regression during development).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from seaweedfs_tpu.query import engine
+    from seaweedfs_tpu.query.scan import ScanPlan
+
+    # ~26 MB block of distinct rows, repeated to reach size_mb: row text
+    # varies within a block (the kernels have no caching to defeat, so
+    # block repetition only saves generation time)
+    regions = ("east", "west", "north", "south")
+    lines = [f"{i},{regions[i & 3]},{i % 1000},r{i:07d}"
+             for i in range(1 << 20)]
+    body = ("\n".join(lines) + "\n").encode()
+    reps = max(1, -(-size_mb * 1024 * 1024 // len(body)))
+    data = b"id,region,score,name\n" + body * reps
+    del lines, body
+
+    select = ["id", "name"]
+    where = {"and": [
+        {"field": "region", "op": "=", "value": "east"},
+        {"field": "score", "op": ">", "value": 995},
+    ]}
+    out = {"size_mb": round(len(data) / 1e6, 1)}
+
+    # pure-Python baseline: one run (it IS the slow case being replaced;
+    # repeating a minutes-scale scan buys no precision worth the wall)
+    t0 = time.perf_counter()
+    base = engine.run_query(data, "csv", select=select, where=where)
+    out["engine_s"] = round(time.perf_counter() - t0, 2)
+    out["rows_matched"] = len(base)
+
+    # 4 MiB chunks — the shape the filer's prefetching chunk stream
+    # actually delivers, and measurably faster than one giant buffer
+    # (the structural-index intermediates stay cache-sized)
+    def chunks():
+        for i in range(0, len(data), 4 << 20):
+            yield data[i:i + (4 << 20)]
+
+    for label, backend in (("numpy", "numpy"), ("jax", "cpu")):
+        try:
+            plan = ScanPlan(select=select, where=where,
+                            input_format="csv", backend=backend)
+        except Exception as e:  # noqa: BLE001 — record, keep the rest
+            out[f"{label}_error"] = str(e)[:200]
+            continue
+        # warm: full input, so every pow2 row-batch bucket (including the
+        # final partial batch's) is compiled before the timed runs
+        rows = [r for b in plan.scan_iter(chunks()) for r in b]
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rows = [r for b in plan.scan_iter(chunks()) for r in b]
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        out[f"{label}_s"] = round(best, 3)
+        out[f"{label}_mbps"] = round(len(data) / best / 1e6, 1)
+        out[f"{label}_speedup"] = round(out["engine_s"] / best, 1)
+        out[f"{label}_identical"] = rows == base
+        out[f"{label}_backend"] = plan.kernels.name
+    print(json.dumps(out))
+
+
 def _run_probe(args: list[str], timeout: int = 420):
     cmd = [sys.executable, os.path.abspath(__file__)] + args
     return subprocess.run(
@@ -1034,6 +1110,19 @@ def main() -> None:
     except subprocess.TimeoutExpired:
         log("extras probe timed out")
 
+    # -- query pushdown: vectorized scan vs pure-Python engine (CPU-only) -----
+    query_bench = None
+    try:
+        r = _run_probe(["--probe-query", "256"], timeout=900)
+        if r.returncode == 0 and r.stdout.strip():
+            query_bench = json.loads(r.stdout.strip().splitlines()[-1])
+            log(f"query: {query_bench}")
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            log(f"query probe failed: {tail[0][:140]}")
+    except subprocess.TimeoutExpired:
+        log("query probe timed out")
+
     log(f"best encode: {best:.2f} GB/s at {best_cfg}, total {time.perf_counter() - t_setup:.0f}s")
     print(
         json.dumps(
@@ -1063,6 +1152,7 @@ def main() -> None:
                     e2e.get("disk", {}).get("gbps")
                 ),
                 "overlap_efficiency": overlap_eff,
+                "query": query_bench,
                 "config": {
                     "rs": [10, 4],
                     "kernel": "pallas-fused",
@@ -1086,6 +1176,8 @@ if __name__ == "__main__":
         probe_rebuild_stream(int(sys.argv[2]), int(sys.argv[3]))
     elif sys.argv[1:2] == ["--probe-extras"]:
         probe_extras(float(sys.argv[2]) if len(sys.argv) > 2 else 240.0)
+    elif sys.argv[1:2] == ["--probe-query"]:
+        probe_query(int(sys.argv[2]) if len(sys.argv) > 2 else 256)
     elif len(sys.argv) >= 4 and sys.argv[1] == "--probe-smallfile":
         probe_smallfile(int(sys.argv[2]), int(sys.argv[3]))
     elif len(sys.argv) >= 4 and sys.argv[1] == "--probe-filer-pipe":

@@ -20,9 +20,13 @@ and through call arguments into project functions (bounded depth); an
 interprocedural finding is reported at the *call site* where the wire
 value escapes, naming the chain to the sink.
 
-Scope: ``server/``, ``s3api/``, ``messaging/`` — the layers that parse
-requests.  (``query/`` names its SQL structures ``query``; that is not
-wire data, and the layer never seeks by client-sent numbers directly.)
+Scope: ``server/``, ``s3api/``, ``messaging/``, ``query/`` — the layers
+that parse requests.  ``query/`` joined the scope when it grew the
+SelectObjectContent protocol (select.py): Expression text and the
+serialization fields come straight off the wire, and the event-stream
+encoder computes frame lengths from them, so a raw request value
+reaching a size position there is exactly the bug class this rule
+exists for.
 """
 
 from __future__ import annotations
@@ -35,7 +39,7 @@ from . import Violation
 from .callgraph import CallGraph, FuncInfo, Project
 from .rules import _REQUESTISH, _terminal_name
 
-_SCOPES = ("server/", "s3api/", "messaging/")
+_SCOPES = ("server/", "s3api/", "messaging/", "query/")
 
 _SANITIZERS = frozenset(
     {

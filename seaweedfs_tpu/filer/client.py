@@ -119,6 +119,28 @@ class FilerClient:
             headers={"Range": rng} if rng else None, timeout=60,
         )
 
+    def select(self, path: str, request_xml: bytes) -> tuple[int, bytes, dict]:
+        """POST the raw SelectObjectContent request XML to the filer's
+        /_select for ``path`` → (status, event_stream_bytes, error_dict).
+        On success the body is the framed AWS event stream; on rejection
+        the filer's JSON error (with its S3 ``error_code``) is decoded so
+        the gateway can map it onto the wire."""
+        status, data, _ = http_bytes_headers(
+            "POST",
+            self.base + "/_select?"
+            + urllib.parse.urlencode({"path": path}),
+            body=request_xml,
+            timeout=600,
+            headers={"Content-Type": "application/xml"},
+        )
+        if status == 200:
+            return 200, data, {}
+        try:
+            err = json.loads(data)
+        except ValueError:
+            err = {"error": data.decode("utf-8", "replace")[:200]}
+        return status, b"", err
+
     # -- entry level ----------------------------------------------------------
     def get_entry(self, path: str) -> Optional[dict]:
         status, body = http_bytes("GET", self._u(path, meta="true"))

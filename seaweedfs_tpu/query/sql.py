@@ -1,4 +1,4 @@
-"""SQL front-end for the query engine — the piece the reference left
+r"""SQL front-end for the query engine — the piece the reference left
 unfinished (`weed/query/sqltypes` has the value model but no parser wired
 to `volume_grpc_query.go`; S3 Select clients expect
 `SELECT ... FROM s3object WHERE ...`).
@@ -8,10 +8,11 @@ Grammar (S3-Select subset):
     SELECT * | field[, field...]         (dotted paths allowed)
     FROM <ident>                          (table name is cosmetic)
     [WHERE <expr>]                        (=, !=, <>, <, <=, >, >=,
-                                           LIKE 'pat%' , NOT, AND, OR,
+                                           LIKE with full %/_ wildcards and
+                                           \%/\_ escapes, NOT, AND, OR,
                                            parentheses; string/number
                                            literals; single or double quotes)
-    [LIMIT <n>]
+    [LIMIT <n>]                           (strict ascii uint)
 
 `parse_sql` compiles to the engine's filter dict ({"and": [...]} etc.), so
 evaluation stays in one place (engine._matches).
@@ -22,6 +23,7 @@ from __future__ import annotations
 import re
 from typing import Any, Optional
 
+from ..util.parsers import parse_ascii_uint
 from .engine import run_query
 
 _TOKEN = re.compile(
@@ -93,9 +95,14 @@ class _Parser:
         if self.peek() == ("kw", "limit"):
             self.next()
             text = self.expect("num")
-            if "." in text or text.startswith("-"):
-                raise SqlError(f"LIMIT must be a non-negative integer: {text}")
-            limit = int(text)
+            try:
+                # the shared strict wire parser: ascii digits only, so
+                # "-5", "2.5", "+3" and "1_0" all fail the same way
+                limit = parse_ascii_uint(text)
+            except ValueError:
+                raise SqlError(
+                    f"LIMIT must be a non-negative integer: {text}"
+                ) from None
         if self.peek()[0] != "eof":
             raise SqlError(f"trailing input at {self.peek()[1]!r}")
         return select, where, limit
@@ -149,27 +156,55 @@ class _Parser:
         return {"field": field, "op": op, "value": value}
 
     def _like(self, field: str) -> dict:
-        pat = self._literal()
-        if not isinstance(pat, str):
+        # take the RAW quoted body: _literal()'s general unescape would
+        # collapse \% / \_ into bare wildcards before we can see them
+        kind, text = self.next()
+        if kind != "str":
             raise SqlError("LIKE needs a string pattern")
-        # the engine's substring ops cover the common S3-Select shapes;
-        # %x% → contains, x% → starts_with, exact → equals. Any wildcard
-        # left in the BODY after stripping the edges (e.g. '%a%b%') has no
-        # substring-op equivalent — fail loudly rather than match a
-        # literal '%' (ADVICE r2)
-        if pat.startswith("%") and pat.endswith("%") and len(pat) >= 2:
-            body = pat[1:-1]
-            if "%" in body or "_" in body:
-                raise SqlError(f"unsupported LIKE pattern {pat!r}")
-            return {"field": field, "op": "contains", "value": body}
-        if pat.endswith("%"):
-            body = pat[:-1]
-            if "%" in body or "_" in body:
-                raise SqlError(f"unsupported LIKE pattern {pat!r}")
-            return {"field": field, "op": "starts_with", "value": body}
-        if "%" in pat or "_" in pat:
-            raise SqlError(f"unsupported LIKE pattern {pat!r}")
-        return {"field": field, "op": "=", "value": pat}
+        body = text[1:-1]
+        atoms: list[tuple] = []  # ("lit", ch) | ("any",) | ("one",)
+        i = 0
+        while i < len(body):
+            c = body[i]
+            if c == "\\" and i + 1 < len(body):
+                atoms.append(("lit", body[i + 1]))
+                i += 2
+            elif c == "%":
+                atoms.append(("any",))
+                i += 1
+            elif c == "_":
+                atoms.append(("one",))
+                i += 1
+            else:
+                atoms.append(("lit", c))
+                i += 1
+        lits = "".join(a[1] for a in atoms if a[0] == "lit")
+        # the engine's substring ops cover the common S3-Select shapes
+        # (and are the ones the scan kernels vectorize): %x% → contains,
+        # x% → starts_with, no wildcards → equals; anything else compiles
+        # to the general "like" op in canonical escaped form
+        if all(a[0] == "lit" for a in atoms):
+            return {"field": field, "op": "=", "value": lits}
+        if (
+            len(atoms) >= 2
+            and atoms[0] == ("any",)
+            and atoms[-1] == ("any",)
+            and all(a[0] == "lit" for a in atoms[1:-1])
+        ):
+            return {"field": field, "op": "contains", "value": lits}
+        if atoms[-1] == ("any",) and all(a[0] == "lit" for a in atoms[:-1]):
+            return {"field": field, "op": "starts_with", "value": lits}
+        canonical = []
+        for a in atoms:
+            if a[0] == "any":
+                canonical.append("%")
+            elif a[0] == "one":
+                canonical.append("_")
+            elif a[1] in "\\%_":
+                canonical.append("\\" + a[1])
+            else:
+                canonical.append(a[1])
+        return {"field": field, "op": "like", "value": "".join(canonical)}
 
     def _literal(self) -> Any:
         kind, text = self.next()

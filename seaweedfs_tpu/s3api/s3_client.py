@@ -16,6 +16,7 @@ from datetime import datetime, timezone
 from typing import Optional
 
 from ..util.parsers import tolerant_uint
+from .xml_util import find_text, parse_xml, to_xml
 
 
 class S3Client:
@@ -378,3 +379,59 @@ class S3Client:
         if v2:
             params["list-type"] = "2"
         return self.request("GET", f"/{bucket}", query=params)
+
+    def select_object_content(
+        self,
+        bucket: str,
+        key: str,
+        expression: str,
+        input_format: str = "csv",
+        compression: str = "NONE",
+        output_format: str = "",
+        request_progress: bool = False,
+    ) -> tuple[bytes, dict]:
+        """SelectObjectContent: POST ?select&select-type=2, decode the
+        event stream (CRC-verified) → (records_bytes, stats_dict).
+        S3 errors raise IOError carrying the error code."""
+        in_ser: dict = {"CompressionType": compression}
+        if input_format == "csv":
+            in_ser["CSV"] = {"FileHeaderInfo": "USE"}
+        else:
+            in_ser["JSON"] = {"Type": "LINES"}
+        out_fmt = output_format or input_format
+        out_ser = {"CSV": {}} if out_fmt == "csv" else {"JSON": {}}
+        req: dict = {
+            "Expression": expression,
+            "ExpressionType": "SQL",
+            "InputSerialization": in_ser,
+            "OutputSerialization": out_ser,
+        }
+        if request_progress:
+            req["RequestProgress"] = {"Enabled": True}
+        body = to_xml("SelectObjectContentRequest", req, xmlns="")
+        status, data, _ = self.request(
+            "POST",
+            f"/{bucket}/{key}",
+            query={"select": "", "select-type": "2"},
+            body=body,
+            headers={"Content-Type": "application/xml"},
+        )
+        if status != 200:
+            code = find_text(parse_xml(data), "Code", "InternalError")
+            raise IOError(f"select {bucket}/{key}: {code} (HTTP {status})")
+        from ..query.select import iter_events
+
+        records, stats = [], {}
+        for ev in iter_events(data):
+            etype = ev["headers"].get(":event-type", "")
+            if etype == "Records":
+                records.append(ev["payload"])
+            elif etype == "Stats":
+                sx = parse_xml(ev["payload"])
+                stats = {
+                    t: tolerant_uint(find_text(sx, t, "0"), 0)
+                    for t in (
+                        "BytesScanned", "BytesProcessed", "BytesReturned"
+                    )
+                }
+        return b"".join(records), stats

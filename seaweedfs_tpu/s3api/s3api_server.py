@@ -60,6 +60,12 @@ _ERR_STATUS = {
     "NoSuchBucketPolicy": 404,
     "AuthorizationHeaderMalformed": 400,
     "AuthorizationQueryParametersError": 400,
+    # SelectObjectContent request rejections (query/select.py)
+    "InvalidRequest": 400,
+    "InvalidTextEncoding": 400,
+    "InvalidExpressionType": 400,
+    "InvalidCompressionFormat": 400,
+    "UnsupportedSqlStructure": 400,
     "InternalError": 500,
 }
 
@@ -367,6 +373,34 @@ class S3ApiServer:
                 },
             },
         )
+
+    def _select_object(self, bucket, key, query, body):
+        """SelectObjectContent (POST /bucket/key?select&select-type=2):
+        validate the request XML at the gateway so protocol errors never
+        round-trip, then run the scan on the filer — next to its
+        prefetching chunk stream — and relay the framed event stream."""
+        if query.get("select-type") != "2":
+            return _err(
+                "InvalidRequest", key, "select-type=2 is required"
+            )
+        from ..query import select as s3select
+
+        try:
+            s3select.parse_select_request(body)
+        except s3select.SelectError as e:
+            return _err(e.code, key, e.message)
+        path = self._object_path(bucket, key)
+        entry = self.client.get_entry(path)
+        if entry is None or entry.get("is_directory"):
+            return _err("NoSuchKey", key)
+        status, payload, err = self.client.select(path, body)
+        if status != 200:
+            return _err(
+                err.get("error_code") or "InternalError",
+                key,
+                err.get("error", ""),
+            )
+        return 200, payload, {"Content-Type": "application/octet-stream"}
 
     def _get_object(self, bucket, key, headers, head=False):
         path = self._object_path(bucket, key)
@@ -1084,6 +1118,12 @@ class S3ApiServer:
                 return self._put_tagging(bucket, key, body)
             if method == "DELETE":
                 return self._delete_tagging(bucket, key)
+        if method == "POST" and "select" in query:
+            # SelectObjectContent reads object content: gate exactly like
+            # a GET of the same key
+            if not allowed(s3auth.ACTION_READ, "s3:GetObject"):
+                return _err("AccessDenied", path)
+            return self._select_object(bucket, key, query, body)
         if method == "POST" and "uploads" in query:
             if not allowed(s3auth.ACTION_WRITE):
                 return _err("AccessDenied", path)

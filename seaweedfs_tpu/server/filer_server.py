@@ -169,8 +169,10 @@ class FilerServer:
         read_window: int = 4,
         write_window: int = 4,
     ):
-        from ..stats import default_registry
+        from ..stats import default_registry, query_stats
         from ..util.chunk_cache import TieredChunkCache
+
+        self._query_stats = query_stats
 
         self.jwt_signing_key = jwt_signing_key
         # volume read gate key (security.toml jwt.signing.read.key — shared
@@ -391,6 +393,9 @@ class FilerServer:
             # OrderedLock sanitizer counters + observed order edges
             # (all-zero unless the process runs with SWEED_LOCK_CHECK=1)
             "locks": lock_stats(),
+            # scan-engine counters (rows/bytes through /_query and
+            # /_select plans, kernel vs exact-lane split)
+            "query": self._query_stats(),
         }
 
     def _h_metrics(self, h, path, q, body):
@@ -438,10 +443,39 @@ class FilerServer:
                         return status, r
             except Exception as e:  # noqa: BLE001 — locality is best-effort
                 glog.V(1).info("data-local query fell back: %s", e)
-        data = self._read_range(entry, 0, entry.file_size())
-        from ..query import execute_request
+        from ..query import scan_request
 
-        return execute_request(data, req)
+        return scan_request(self._entry_chunks(entry), req)
+
+    def _entry_chunks(self, entry: Entry):
+        """An entry's full content as a streaming chunk iterator — the
+        prefetching read path (_stream_range rides util/pipeline.
+        prefetch_iter), so a multi-chunk object feeds the scan engine's
+        device batches without stalling on volume round-trips."""
+        size = entry.file_size()
+        return self._stream_range(entry, 0, size) if size else iter(())
+
+    def _h_select(self, h, path, q, body):
+        """S3 SelectObjectContent execution: the gateway forwards the
+        client's raw request XML; the reply body is the framed AWS
+        event stream (Records/Progress/Stats/End).  Protocol errors come
+        back as JSON with the S3 error code for the gateway to map."""
+        target = q.get("path", "")
+        try:
+            entry = self.filer.find_entry(target)
+        except NotFoundError:
+            return 404, {"error": f"{target} not found"}
+        from ..query import select as s3select
+
+        try:
+            req = s3select.parse_select_request(body)
+            payload = b"".join(
+                s3select.run_select(self._entry_chunks(entry), req)
+            )
+        except s3select.SelectError as e:
+            return 400, {"error": e.message, "error_code": e.code}
+        h.extra_headers = {"Content-Type": "application/octet-stream"}
+        return 200, payload
 
     @staticmethod
     def _sigs(q) -> Optional[list[int]]:
@@ -974,6 +1008,7 @@ class FilerServer:
                 ("GET", "/_status", fs._h_status),
                 ("GET", "/metrics", fs._h_metrics),
                 ("POST", "/_query", fs._h_query),
+                ("POST", "/_select", fs._h_select),
                 ("GET", "/_kv/", fs._h_kv),
                 ("PUT", "/_kv/", fs._h_kv),
                 ("POST", "/_kv/", fs._h_kv),

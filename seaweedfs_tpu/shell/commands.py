@@ -1082,6 +1082,29 @@ def bucket_create(env: CommandEnv, name: str) -> dict:
     return {"created": name}
 
 
+def query(
+    env: CommandEnv, sql: str, path: str, input_format: str = "csv"
+) -> dict:
+    """Server-side S3-Select scan of a stored CSV/JSON file (the query
+    path `weed/shell` never grew; the filer's /_query runs the vectorized
+    scan engine, pushing single-chunk plain entries down to the volume
+    server holding the needle)."""
+    if not sql:
+        raise RuntimeError("query needs a SQL string argument")
+    if not path:
+        raise RuntimeError("query needs -path=FILE")
+    target = _fs_resolve(env, path)
+    r = http_json(
+        "POST",
+        f"http://{env.filer}/_query",
+        {"path": target, "sql": sql, "input": input_format},
+        timeout=600,
+    )
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return r
+
+
 def bucket_delete(env: CommandEnv, name: str) -> dict:
     from ..server.http_util import http_bytes
 

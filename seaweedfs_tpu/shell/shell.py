@@ -37,6 +37,7 @@ HELP = """commands:
   fs.configure [-locationPrefix=/p/ -collection=C -replication=XYZ
                 -ttl=T -apply=true|-delete=true]
   bucket.list | bucket.create -name=B | bucket.delete -name=B
+  query -path=FILE [-input=csv|json] 'SELECT ... FROM s3object [WHERE ...]'
   lock | unlock
   help | exit
 """
@@ -61,7 +62,7 @@ def _flags(parts: list[str]) -> dict[str, str]:
 _RETRY_SAFE = {
     "help", "cluster.status", "volume.list", "collection.list",
     "bucket.list", "fs.ls", "fs.du", "fs.tree", "fs.cat", "fs.pwd",
-    "fs.meta.cat",
+    "fs.meta.cat", "query",
 }
 
 
@@ -227,6 +228,13 @@ def run_command(env: CommandEnv, line: str) -> object:
         return C.collection_list(env)
     if cmd == "collection.delete":
         return C.collection_delete(env, flags["collection"])
+    if cmd == "query":
+        return C.query(
+            env,
+            args[0] if args else "",
+            flags.get("path", ""),
+            flags.get("input", "csv"),
+        )
     if cmd == "lock":
         return env.lock()
     if cmd == "unlock":

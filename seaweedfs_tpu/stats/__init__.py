@@ -6,8 +6,10 @@ from .metrics import (  # noqa: F401
     Counter,
     Gauge,
     Histogram,
+    QUERY_COUNTERS,
     Registry,
     default_registry,
     disk_status,
     memory_status,
+    query_stats,
 )

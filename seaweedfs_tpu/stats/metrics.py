@@ -37,6 +37,11 @@ class Counter:
     def value(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def total(self) -> float:
+        """Sum across all label sets (for compact /_status views)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         for key, v in sorted(self._values.items()):
@@ -194,6 +199,52 @@ def register_lock_metrics(registry: Optional[Registry] = None) -> None:
 
 
 register_lock_metrics()
+
+
+def register_query_metrics(
+    registry: Optional[Registry] = None,
+) -> dict[str, Counter]:
+    """Counters for the vectorized scan engine (query/scan.py): rows and
+    bytes pushed through scan plans, and the kernel-vs-exact-lane split
+    that tells an operator whether their data shape actually vectorizes.
+    Scans are labeled by backend (jax-cpu / jax-tpu / numpy)."""
+    reg = registry if registry is not None else default_registry
+    return {
+        "rows": reg.counter(
+            "sweed_query_rows_scanned_total",
+            "documents evaluated by scan plans",
+        ),
+        "bytes": reg.counter(
+            "sweed_query_bytes_scanned_total",
+            "object bytes fed through scan plans",
+        ),
+        "kernel": reg.counter(
+            "sweed_query_rows_kernel_total",
+            "rows decided by the vectorized kernels",
+        ),
+        "fallback": reg.counter(
+            "sweed_query_rows_fallback_total",
+            "rows routed to the row-at-a-time exact lane",
+        ),
+        "scans": reg.counter(
+            "sweed_query_scans_total",
+            "scan plan executions, by backend label",
+        ),
+    }
+
+
+QUERY_COUNTERS = register_query_metrics()
+
+
+def query_stats() -> dict:
+    """Snapshot of the scan-engine counters for /_status."""
+    return {
+        "rows_scanned": QUERY_COUNTERS["rows"].total(),
+        "bytes_scanned": QUERY_COUNTERS["bytes"].total(),
+        "rows_kernel": QUERY_COUNTERS["kernel"].total(),
+        "rows_fallback": QUERY_COUNTERS["fallback"].total(),
+        "scans": QUERY_COUNTERS["scans"].total(),
+    }
 
 
 # -- host probes (stats/disk.go, memory.go) ----------------------------------

@@ -241,19 +241,31 @@ def test_backup_aborts_on_midrun_compaction(cluster, tmp_path, monkeypatch):
 
 # -- round-2 advisor findings -------------------------------------------------
 
-def test_like_interior_wildcards_rejected():
-    """LIKE '%a%b%' has no substring-op equivalent; it must raise, not
-    silently match a literal '%' (ADVICE r2)."""
-    from seaweedfs_tpu.query.sql import SqlError, parse_sql
+def test_like_interior_wildcards_supported():
+    """LIKE with interior %/_ wildcards evaluates as real SQL LIKE now
+    (the r2 advisor had these rejected as unimplementable; the general
+    'like' op landed with the query pushdown work). The substring-op
+    compilations that the scan kernels vectorize are preserved."""
+    from seaweedfs_tpu.query import run_sql
+    from seaweedfs_tpu.query.sql import parse_sql
 
-    for pat in ("%a%b%", "a%b%", "%a_b%", "a_b%"):
-        with pytest.raises(SqlError):
-            parse_sql(f"SELECT * FROM s3object WHERE name LIKE '{pat}'")
-    # the supported shapes still parse
+    # fast shapes still compile to the vectorizable substring ops
     _, where, _ = parse_sql("SELECT * FROM s3object WHERE name LIKE '%ab%'")
     assert where == {"field": "name", "op": "contains", "value": "ab"}
     _, where, _ = parse_sql("SELECT * FROM s3object WHERE name LIKE 'ab%'")
     assert where == {"field": "name", "op": "starts_with", "value": "ab"}
+    # general shapes compile to the canonical-escaped "like" op
+    _, where, _ = parse_sql("SELECT * FROM s3object WHERE name LIKE 'a_b'")
+    assert where == {"field": "name", "op": "like", "value": "a_b"}
+    _, where, _ = parse_sql("SELECT * FROM s3object WHERE name LIKE '%a%b%'")
+    assert where == {"field": "name", "op": "like", "value": "%a%b%"}
+
+    docs = b'{"name": "axb"}\n{"name": "ab"}\n{"name": "a%b"}\n'
+    got = run_sql(docs, "SELECT name FROM s3object WHERE name LIKE 'a_b'")
+    assert got == [{"name": "axb"}, {"name": "a%b"}]
+    # escaped wildcard matches only the literal character
+    got = run_sql(docs, "SELECT name FROM s3object WHERE name LIKE 'a\\%b'")
+    assert got == [{"name": "a%b"}]
 
 
 def test_policy_principal_arn_matching_tightened():

@@ -171,7 +171,7 @@ def test_sql_csv_and_errors():
         "SELECT FROM t",
         "SELECT * FROM t WHERE",
         "SELECT * FROM t WHERE x ~ 3",
-        "SELECT * FROM t WHERE x LIKE 'a_b'",
+        "SELECT * FROM t WHERE x LIKE 5",
         "SELECT * FROM t LIMIT 2 extra",
         "SELECT * FROM t LIMIT 2.5",
         "SELECT * FROM t LIMIT -5",
