@@ -479,6 +479,249 @@ def probe_filer_pipe(size_mb: int, window: int, chunk_mb: int = 4) -> None:
     }))
 
 
+def probe_serving(mode: str, conns_csv: str, total: int) -> None:
+    """Child mode: keep-alive smallfile GET storm against a filer running
+    the given serving core (SWEED_SERVING=threads|aio). The filer runs in
+    its own process; this process drives C concurrent keep-alive
+    connections (asyncio client — holding 1k+ sockets is cheap on the
+    load-generator side regardless of which core the SERVER uses) and
+    sweeps C over `conns_csv`. Bodies are checked against the uploaded
+    bytes on every response, so rps numbers only count verified replies.
+
+    Two phases per connection count:
+    - ``sat``   — closed loop, connection setup included: the storm
+      arrives and the core must accept AND serve it. This is where
+      thread-per-connection dies (a thread spawned per accept behind a
+      5-deep listen backlog); rps is the capacity headline. p99 here is
+      dominated by queueing (Little's law: C in flight / rps), so it is
+      reported but NOT the latency verdict.
+    - ``paced`` — open loop at a fixed offered rate (well under the
+      64-conn capacity) over pre-opened, ramped connections: per-request
+      latency now measures serving-core overhead at C connections, not
+      saturation queueing. This is the p99-bounded-vs-64-conns verdict.
+
+    Prints one JSON line:
+    {"mode", "sweep": [{conns, sat: {...}, paced: {...}}]}."""
+    import asyncio
+    import socket
+    import tempfile
+
+    from seaweedfs_tpu.filer.client import FilerClient
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def wait_port(port, timeout=20.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                return
+            except OSError:
+                time.sleep(0.1)
+        raise RuntimeError(f"server on :{port} never came up")
+
+    def spawn(code, extra_env=None):
+        env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
+        return subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+
+    mp, fp = free_port(), free_port()
+    procs = []
+    # the turbo engine would serve fid GETs natively on the VOLUME, but
+    # the unit under test is the FILER's serving core; warm chunk cache
+    # on the filer keeps volume round-trips out of the measured path so
+    # the sweep isolates reactor-vs-thread-per-connection overhead
+    serve_env = {"SWEED_SERVING": mode, "SWEED_TURBO": "0"}
+    with tempfile.TemporaryDirectory() as tmp:
+        try:
+            procs.append(spawn(
+                "import time\n"
+                "from seaweedfs_tpu.server.master_server import MasterServer\n"
+                f"MasterServer(host='127.0.0.1', port={mp}).start()\n"
+                "time.sleep(3600)\n",
+                extra_env=serve_env,
+            ))
+            wait_port(mp)
+            vp = free_port()
+            procs.append(spawn(
+                "import time\n"
+                "from seaweedfs_tpu.server.volume_server import VolumeServer\n"
+                f"VolumeServer([{tmp!r}], host='127.0.0.1', port={vp}, "
+                f"master_url='127.0.0.1:{mp}').start()\n"
+                "time.sleep(3600)\n",
+                extra_env=serve_env,
+            ))
+            procs.append(spawn(
+                "import time\n"
+                "from seaweedfs_tpu.server.filer_server import FilerServer\n"
+                f"FilerServer(host='127.0.0.1', port={fp}, "
+                f"master_url='127.0.0.1:{mp}').start()\n"
+                "time.sleep(3600)\n",
+                extra_env=serve_env,
+            ))
+            wait_port(vp)
+            wait_port(fp)
+            time.sleep(0.5)  # volume heartbeat → master topology
+            client = FilerClient(f"127.0.0.1:{fp}")
+            import numpy as np
+
+            rng = np.random.default_rng(11)
+            bodies = {}
+            for i in range(64):
+                data = rng.integers(0, 256, 1024, dtype=np.uint8).tobytes()
+                client.put_object(f"/s/{i}", data)
+                bodies[f"/s/{i}"] = data
+            paths = sorted(bodies)
+            for p in paths:  # warm the filer's chunk cache
+                client.get_object(p)
+
+            async def connect(counters, n_req, attempts=3):
+                for attempt in range(attempts):  # ride out SYN-storm drops
+                    try:
+                        return await asyncio.wait_for(
+                            asyncio.open_connection("127.0.0.1", fp),
+                            timeout=10,
+                        )
+                    except (OSError, asyncio.TimeoutError):
+                        await asyncio.sleep(0.2 * (attempt + 1))
+                counters["failed"] += n_req
+                return None, None
+
+            async def pump(reader, writer, wid, n_req, counters,
+                           latencies, interval, t_start):
+                try:
+                    for k in range(n_req):
+                        if interval:
+                            # absolute schedule (open loop): a slow reply
+                            # must not thin the offered load behind it
+                            due = t_start + k * interval
+                            delay = due - time.perf_counter()
+                            if delay > 0:
+                                await asyncio.sleep(delay)
+                        p = paths[(wid + k) % len(paths)]
+                        req = (
+                            f"GET {p} HTTP/1.1\r\nHost: b\r\n"
+                            f"Content-Length: 0\r\n\r\n"
+                        ).encode()
+                        t0 = time.perf_counter()
+                        try:
+                            writer.write(req)
+                            await writer.drain()
+                            head = await asyncio.wait_for(
+                                reader.readuntil(b"\r\n\r\n"), 60
+                            )
+                            status = int(head.split(b" ", 2)[1])
+                            clen = 0
+                            for ln in head.split(b"\r\n"):
+                                if ln.lower().startswith(b"content-length:"):
+                                    clen = int(ln.split(b":")[1])
+                            body = await asyncio.wait_for(
+                                reader.readexactly(clen), 60
+                            )
+                        except (OSError, asyncio.TimeoutError,
+                                asyncio.IncompleteReadError,
+                                asyncio.LimitOverrunError):
+                            counters["failed"] += n_req - k
+                            return  # connection is toast
+                        latencies.append(time.perf_counter() - t0)
+                        if status != 200 or body != bodies[p]:
+                            counters["mismatched"] += 1
+                finally:
+                    writer.close()
+
+            def summarize(c, latencies, counters, wall):
+                lat = sorted(latencies)
+                ok = len(lat)
+                return {
+                    "conns": c,
+                    "n": ok,
+                    "rps": round(ok / wall, 1) if wall > 0 else 0.0,
+                    "p50_ms": round(lat[ok // 2] * 1e3, 2) if ok else None,
+                    "p99_ms": round(
+                        lat[max(0, int(ok * 0.99) - 1)] * 1e3, 2
+                    ) if ok else None,
+                    "failed": counters["failed"],
+                    "mismatched": counters["mismatched"],
+                }
+
+            async def sat_phase(c, n_total):
+                counters = {"failed": 0, "mismatched": 0}
+                latencies = []
+                per = [n_total // c + (1 if i < n_total % c else 0)
+                       for i in range(c)]
+
+                async def worker(wid, n_req):
+                    reader, writer = await connect(counters, n_req)
+                    if writer is None:
+                        return
+                    await pump(reader, writer, wid, n_req, counters,
+                               latencies, 0.0, 0.0)
+
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(worker(i, per[i]) for i in range(c) if per[i])
+                )
+                return summarize(
+                    c, latencies, counters, time.perf_counter() - t0
+                )
+
+            async def paced_phase(c, n_total, target_rps):
+                counters = {"failed": 0, "mismatched": 0}
+                latencies = []
+                per = [n_total // c + (1 if i < n_total % c else 0)
+                       for i in range(c)]
+                interval = c / target_rps  # per-connection request period
+                ramp = min(5.0, max(0.5, c / 250.0))
+
+                async def worker(wid, n_req):
+                    # stagger connection setup so the listen backlog sees a
+                    # trickle, then stagger request phases across the period
+                    await asyncio.sleep(wid * ramp / c)
+                    reader, writer = await connect(counters, n_req)
+                    if writer is None:
+                        return
+                    t_start = (time.perf_counter() + ramp
+                               + (wid % 97) / 97.0 * interval)
+                    await pump(reader, writer, wid, n_req, counters,
+                               latencies, interval, t_start)
+
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(worker(i, per[i]) for i in range(c) if per[i])
+                )
+                # offered-load wall, net of ramp, so rps reflects the pace
+                wall = max(time.perf_counter() - t0 - 2 * ramp, 1e-3)
+                return summarize(c, latencies, counters, wall)
+
+            out = {"mode": mode, "sweep": [], "paced_target_rps": 1200}
+            for c in [int(x) for x in conns_csv.split(",") if x]:
+                row = {"conns": c}
+                row["sat"] = asyncio.run(sat_phase(c, total))
+                row["paced"] = asyncio.run(paced_phase(
+                    c, min(total, 6000), out["paced_target_rps"]
+                ))
+                out["sweep"].append(row)
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    print(json.dumps(out))
+
+
 class _NullSink:
     """File-like that discards writes: isolates read+H2D+compute+D2H from
     any filesystem at all (the 'where is the first real bottleneck' probe)."""
@@ -935,6 +1178,62 @@ def main() -> None:
             f"byte_identical={filer_pipe['speedup']['byte_identical']}"
         )
 
+    # -- serving core: thread-per-connection vs asyncio reactor ---------------
+    # same filer smallfile GET workload, keep-alive connection sweep; the
+    # reactor's case is the high-connection regime where thread-per-conn
+    # burns its wall time on scheduler thrash
+    serving = {}
+    for mode in ("threads", "aio"):
+        try:
+            r = _run_probe(["--probe-serving", mode, "64,1024", "20000"],
+                           timeout=420)
+            if r.returncode == 0 and r.stdout.strip():
+                serving[mode] = json.loads(r.stdout.strip().splitlines()[-1])
+                for row in serving[mode]["sweep"]:
+                    s, p = row["sat"], row["paced"]
+                    log(
+                        f"serving[{mode}] c={row['conns']}: sat "
+                        f"{s['rps']} req/s p99={s['p99_ms']}ms "
+                        f"failed={s['failed']}; paced {p['rps']} req/s "
+                        f"p50={p['p50_ms']}ms p99={p['p99_ms']}ms "
+                        f"failed={p['failed']} mismatched={p['mismatched']}"
+                    )
+            else:
+                tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+                log(f"serving probe [{mode}] failed: {tail[0][:140]}")
+        except subprocess.TimeoutExpired:
+            log(f"serving probe [{mode}] timed out")
+    if len(serving) == 2:
+        by = {
+            (m, row["conns"]): row
+            for m in serving for row in serving[m]["sweep"]
+        }
+        hi = max(c for (_, c) in by)
+        lo = min(c for (_, c) in by)
+        t, a = by.get(("threads", hi)), by.get(("aio", hi))
+        a_lo = by.get(("aio", lo))
+        if t and a and a_lo:
+            p99_hi = a["paced"]["p99_ms"]
+            p99_lo = a_lo["paced"]["p99_ms"]
+            serving["aio_vs_threads"] = {
+                "conns": hi,
+                "sat_rps_ratio": round(
+                    a["sat"]["rps"] / max(t["sat"]["rps"], 1e-9), 2
+                ),
+                "aio_paced_p99_vs_low_conns": round(
+                    p99_hi / max(p99_lo, 1e-9), 2
+                ) if p99_hi and p99_lo else None,
+                "aio_failed": a["sat"]["failed"] + a["paced"]["failed"],
+                "aio_mismatched": (
+                    a["sat"]["mismatched"] + a["paced"]["mismatched"]
+                ),
+            }
+            log(f"serving aio vs threads @c={hi}: "
+                f"{serving['aio_vs_threads']['sat_rps_ratio']}x sat rps; "
+                f"aio paced p99 "
+                f"{serving['aio_vs_threads']['aio_paced_p99_vs_low_conns']}x "
+                f"its c={lo} paced p99")
+
     # -- encode probes in fresh subprocesses ----------------------------------
     best, best_cfg, best_raw = 0.0, None, 0.0
     successes = 0
@@ -1143,6 +1442,7 @@ def main() -> None:
                 "mesh_single_chip_gbps": mesh_gbps,
                 "smallfile": smallfile,
                 "filer_pipe": filer_pipe,
+                "serving": serving,
                 "e2e": e2e,
                 "e2e_note": (
                     "all sinks tunnel-bound on this dev host (~100 MB/s "
@@ -1183,6 +1483,9 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 4 and sys.argv[1] == "--probe-filer-pipe":
         probe_filer_pipe(int(sys.argv[2]), int(sys.argv[3]),
                          int(sys.argv[4]) if len(sys.argv) > 4 else 4)
+    elif len(sys.argv) >= 4 and sys.argv[1] == "--probe-serving":
+        probe_serving(sys.argv[2], sys.argv[3],
+                      int(sys.argv[4]) if len(sys.argv) > 4 else 20000)
     elif len(sys.argv) >= 3 and sys.argv[1] == "--probe-e2e":
         probe_e2e(int(sys.argv[2]),
                   sys.argv[3] if len(sys.argv) > 3 else "disk")

@@ -26,6 +26,9 @@ Interprocedural rules (``callgraph.py`` + ``lockgraph.py`` +
   graph.
 - ``blocking-under-lock`` — a network/disk/sleep/``Future.result`` call
   reachable while a lock is held.
+- ``blocking-on-loop``    — the same blocking calls reachable from an
+  ``async def`` body (they stall the event-loop reactor for every
+  connection it serves); awaited calls are exempt.
 - ``tainted-size``        — a wire-derived value flowing into a
   seek/read/slice/allocation size without ``util/parsers.py``.
 - ``stale-waiver``        — a ``sweedlint: ok`` comment naming a rule

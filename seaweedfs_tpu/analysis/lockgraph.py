@@ -185,8 +185,10 @@ class LockGraphBuilder:
         self._blk_summaries: dict[str, dict[str, Site]] = {}
         self._lock_order_v: list[Violation] = []
         self._blocking_v: list[Violation] = []
+        self._loop_v: list[Violation] = []
         self._collect_decls()
         self._build()
+        self._build_loop_rule()
 
     # -- lock declarations ----------------------------------------------------
     def _collect_decls(self) -> None:
@@ -514,9 +516,95 @@ class LockGraphBuilder:
                     )
                 )
 
+    # -- blocking-on-loop ------------------------------------------------------
+    def _build_loop_rule(self) -> None:
+        """``blocking-on-loop``: the event-loop mirror of
+        blocking-under-lock. A blocking call (socket, fsync, sleep,
+        pooled HTTP, ``Future.result``) executed inside an ``async def``
+        — directly or transitively through sync project callees — runs
+        ON the reactor thread and stalls every connection the loop
+        serves, not just one request. Awaited expressions yield to the
+        loop and are exempt (their coroutine bodies are analyzed as
+        their own async defs); nested defs/lambdas run elsewhere
+        (executor targets, callbacks) and are exempt too."""
+        seen: set[tuple[str, int, str]] = set()
+        for fi in sorted(
+            self.project.functions.values(), key=lambda f: f.qualname
+        ):
+            if not isinstance(fi.node, ast.AsyncFunctionDef):
+                continue
+            if not any(s in fi.relpath for s in _SCOPES):
+                continue
+            env = self.cg.local_types(fi)
+            self._loop_walk(fi, fi.node, env, seen)
+
+    def _loop_walk(
+        self, fi: FuncInfo, node: ast.AST, env: dict, seen: set
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # runs off-loop (executor target / callback)
+            if isinstance(child, ast.Await) and isinstance(
+                child.value, ast.Call
+            ):
+                # the awaited call itself yields to the loop; its
+                # ARGUMENT expressions still execute inline — check them
+                for sub in ast.iter_child_nodes(child.value):
+                    self._loop_walk(fi, sub, env, seen)
+                continue
+            if isinstance(child, ast.Call):
+                self._loop_check_call(fi, child, env, seen)
+            self._loop_walk(fi, child, env, seen)
+
+    def _loop_check_call(
+        self, fi: FuncInfo, call: ast.Call, env: dict, seen: set
+    ) -> None:
+        desc = self._is_blocking_call(call, fi, env)
+        if desc is not None:
+            key = (fi.relpath, call.lineno, desc)
+            if key not in seen:
+                seen.add(key)
+                self._loop_v.append(
+                    Violation(
+                        "blocking-on-loop",
+                        fi.relpath,
+                        call.lineno,
+                        f"{desc} inside async def {fi.name} runs on the "
+                        "event loop and stalls every connection it "
+                        "serves; await an async equivalent or offload "
+                        "via run_in_executor (docs/ANALYSIS.md)",
+                    )
+                )
+            return
+        callee = self.cg.resolve_call(call, fi, env)
+        if callee is None or isinstance(callee.node, ast.AsyncFunctionDef):
+            return  # async callees are analyzed as their own scopes
+        blocking = self._blocking_in(
+            callee, MAX_DEPTH - 1, frozenset({fi.qualname})
+        )
+        for desc, s in sorted(blocking.items()):
+            key = (fi.relpath, call.lineno, desc)
+            if key in seen:
+                continue
+            seen.add(key)
+            chain = f"{callee.name}" + (f" {s.chain}" if s.chain else "")
+            self._loop_v.append(
+                Violation(
+                    "blocking-on-loop",
+                    fi.relpath,
+                    call.lineno,
+                    f"{desc} (via {chain}, {s.relpath}:{s.line}) reachable "
+                    f"from async def {fi.name} without await/executor "
+                    "offload; the loop stalls every connection while it "
+                    "runs (docs/ANALYSIS.md)",
+                )
+            )
+
     # -- violations -----------------------------------------------------------
     def violations(self) -> list[Violation]:
-        out = list(self._blocking_v)
+        out = list(self._blocking_v) + list(self._loop_v)
         for cycle in self.graph.cycles():
             cyc = set(cycle)
             sites: list[tuple[str, int, str]] = []

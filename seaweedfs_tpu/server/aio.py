@@ -1,0 +1,506 @@
+"""Asyncio serving core: the event-loop reactor behind SWEED_SERVING=aio.
+
+Thread-per-connection (`ThreadingHTTPServer`) caps the gateway tier at a
+few hundred concurrent clients: every idle keep-alive connection pins an
+OS thread, and past ~1k threads the GIL convoy + scheduler thrash destroy
+both throughput and p99. This reactor inverts the shape:
+
+- Connections live on ONE event loop. Idle keep-alive costs a parked
+  coroutine (~KBs), not a thread, so 10k+ connections are routine.
+- Request HEADS are parsed on the loop; the handler body then runs in a
+  small bounded worker pool — and it is byte-for-byte the SAME handler
+  code the threads core runs (`JsonHandler`, the S3 gateway's Handler,
+  WebDAV): the shim below instantiates the untouched handler class
+  against loop-bridged rfile/wfile/connection objects. Routing, tolerant
+  parsers and error mapping cannot drift between modes because they are
+  not duplicated.
+- Response bytes flow thread→loop through a bounded `ThreadFlume`
+  (util/aio_pipeline.py — the awaitable re-expression of the PR 3
+  pipeline window): a slow client backpressures the producing worker at
+  `window` chunks instead of buffering the body, and the loop overlaps
+  the socket sends with the worker's next chunk production.
+- Zero-copy replies (`SendfileBody`) ride `loop.sendfile` — the flume
+  carries an ordered sendfile op so kernel-to-socket bytes interleave
+  correctly with userspace header bytes.
+- Admission control is shared with the threads core: past the
+  `SWEED_MAX_INFLIGHT` watermark a fresh connection gets the canned
+  503 + Retry-After and keep-alive responses carry Connection: close.
+
+Lifecycle mirrors the socketserver surface (`start`/`shutdown`/
+`server_close`/`server_address`) so `start_server` callers need no
+changes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import io
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler
+from typing import Optional
+
+from seaweedfs_tpu.util import glog
+from seaweedfs_tpu.util.aio_pipeline import ThreadFlume, ThreadFlumeClosed
+
+from .http_util import (
+    SERVING,
+    admission_reject_response,
+    serving_watermark,
+)
+
+
+def _aio_workers() -> int:
+    import os
+
+    raw = os.environ.get("SWEED_AIO_WORKERS", "32").strip()
+    if not (raw.isascii() and raw.isdigit()):
+        return 32
+    return max(1, int(raw))
+
+
+class _SendfileOp:
+    """Ordered zero-copy marker in the response flume: the pump executes
+    it with loop.sendfile once every byte queued before it has reached
+    the transport, then wakes the waiting worker thread."""
+
+    def __init__(self, file, offset: int, count: Optional[int]):
+        self.file, self.offset, self.count = file, offset, count
+        self._evt = threading.Event()
+        self._result = 0
+        self._exc: Optional[BaseException] = None
+
+    def resolve(self, sent: int) -> None:
+        self._result = sent
+        self._evt.set()
+
+    def reject(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._evt.set()
+
+    def wait(self) -> int:
+        self._evt.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _WfileBridge:
+    """Handler-facing wfile: buffers small writes, pushes blocks into the
+    connection's flume (bounded — blocking the worker, not the loop, when
+    the client reads slowly). A torn-down flume surfaces as
+    BrokenPipeError so untouched handler error paths do the right thing."""
+
+    def __init__(self, flume: ThreadFlume, hw: int = 64 << 10):
+        self._flume = flume
+        self._buf: list = []
+        self._size = 0
+        self._hw = hw
+
+    def write(self, data) -> int:
+        data = bytes(data)
+        self._buf.append(data)
+        self._size += len(data)
+        if self._size >= self._hw:
+            self.flush()
+        return len(data)
+
+    def flush(self) -> None:
+        if not self._buf:
+            return
+        blob = b"".join(self._buf)
+        self._buf.clear()
+        self._size = 0
+        try:
+            self._flume.put(blob)
+        except ThreadFlumeClosed:
+            raise BrokenPipeError("client connection gone") from None
+
+
+class _RfileBridge:
+    """Handler-facing rfile: request-head bytes come from the loop-parsed
+    buffer; body bytes bridge to the connection's StreamReader via
+    run_coroutine_threadsafe. Honors the socket-timeout surface that
+    drain_refused_body drives through handler.connection."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, reader):
+        self._loop = loop
+        self._reader = reader
+        self._head = io.BytesIO()
+        self.timeout: Optional[float] = None
+
+    def set_head(self, rest: bytes) -> None:
+        self._head = io.BytesIO(rest)
+
+    def readline(self, limit: int = -1) -> bytes:
+        line = self._head.readline(limit)
+        if line:
+            return line
+        # headers always live in the head buffer; only pathological
+        # callers land here — byte-at-a-time is fine for them
+        out = bytearray()
+        while True:
+            b = self.read(1)
+            if not b:
+                break
+            out += b
+            if b == b"\n" or (0 < limit <= len(out)):
+                break
+        return bytes(out)
+
+    def read(self, n: int = -1) -> bytes:
+        if n is not None and n >= 0:
+            got = self._head.read(n)
+            need = n - len(got)
+            if need <= 0:
+                return got
+            return got + self._await(self._read_wire(need))
+        return self._head.read() + self._await(self._read_wire(None))
+
+    async def _read_wire(self, n: Optional[int]) -> bytes:
+        out = bytearray()
+        while n is None or len(out) < n:
+            want = (1 << 20) if n is None else min(n - len(out), 1 << 20)
+            chunk = await self._reader.read(want)
+            if not chunk:
+                break
+            out += chunk
+        return bytes(out)
+
+    def _await(self, coro) -> bytes:
+        try:
+            fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        except RuntimeError:
+            raise ConnectionResetError("event loop gone") from None
+        try:
+            return fut.result(self.timeout)
+        except concurrent.futures.TimeoutError:
+            # distinct from builtin TimeoutError until 3.11 — re-raise as
+            # socket.timeout (an OSError), drain_refused_body's cue
+            fut.cancel()
+            raise socket.timeout("timed out") from None
+        except asyncio.CancelledError:
+            raise ConnectionResetError("connection torn down") from None
+
+
+class _ShimConn:
+    """Handler-facing `connection`: the timeout knobs drain_refused_body
+    needs, plus the socket.sendfile surface the zero-copy reply path
+    calls — routed through the flume so the bytes stay ordered."""
+
+    def __init__(self, rfile: _RfileBridge, flume: ThreadFlume):
+        self._rfile = rfile
+        self._flume = flume
+
+    def settimeout(self, t) -> None:
+        self._rfile.timeout = t
+
+    def gettimeout(self):
+        return self._rfile.timeout
+
+    def sendfile(self, file, offset: int = 0, count=None) -> int:
+        op = _SendfileOp(file, offset, count)
+        try:
+            self._flume.put(op)
+        except ThreadFlumeClosed:
+            raise BrokenPipeError("client connection gone") from None
+        return op.wait()
+
+
+def _expect_100_and_flush(h) -> bool:
+    """handle_expect_100 writes '100 Continue' into a buffering wfile;
+    the interim response must hit the wire before the client will send
+    the body, so flush explicitly (the real socket wfile is unbuffered)."""
+    ok = BaseHTTPRequestHandler.handle_expect_100(h)
+    h.wfile.flush()
+    return ok
+
+
+def _run_request(handler_cls, server, conn, rfile, wfile,
+                 client_address, raw_requestline) -> bool:
+    """Run ONE parsed-head request through the untouched handler class in
+    a worker thread; returns close_connection. This is
+    BaseHTTPRequestHandler.handle_one_request minus the socket plumbing:
+    the handler instance is built bare (__new__) against the bridges, so
+    every subclass behavior — routing, parsers, error bytes, logging —
+    is the threads-mode code verbatim."""
+    h = handler_cls.__new__(handler_cls)
+    h.server = server
+    h.client_address = client_address
+    h.connection = conn
+    h.rfile = rfile
+    h.wfile = wfile
+    h.close_connection = True
+    h.raw_requestline = raw_requestline
+    h.requestline = ""
+    h.command = ""
+    h.request_version = handler_cls.default_request_version
+    h.handle_expect_100 = lambda: _expect_100_and_flush(h)
+    try:
+        if not h.parse_request():
+            # parse_request already sent the error response
+            h.wfile.flush()
+            return True
+        mname = "do_" + h.command
+        if not hasattr(h, mname):
+            h.send_error(
+                501, "Unsupported method (%r)" % h.command
+            )
+            h.wfile.flush()
+            return bool(h.close_connection)
+        getattr(h, mname)()
+        h.wfile.flush()
+    except (BrokenPipeError, ConnectionResetError, TimeoutError):
+        h.close_connection = True
+    except Exception:
+        glog.exception("aio handler failed (%s)",
+                       getattr(h, "requestline", ""))
+        h.close_connection = True
+    return bool(h.close_connection)
+
+
+class AioHTTPServer:
+    """Event-loop serving core with the socketserver lifecycle surface.
+
+    One daemon thread runs the loop; `start()` blocks until the listener
+    is bound (raising bind errors in the caller, like ThreadingHTTPServer
+    does) and fills in `server_address` — port 0 works."""
+
+    def __init__(self, handler_cls, host: str, port: int, ssl_context=None):
+        self.handler_cls = handler_cls
+        self.host, self.port = host, port
+        self.server_address = (host, port)
+        self._ssl = ssl_context
+        self._pool = ThreadPoolExecutor(
+            max_workers=_aio_workers(), thread_name_prefix="aio-worker"
+        )
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        self._startup_error: Optional[BaseException] = None
+        self._stop_evt: Optional[asyncio.Event] = None
+        self._stopped = False
+        # loop-confined: every mutation happens on the loop thread
+        self._conns: set = set()
+        self._conn_tasks: set = set()
+        SERVING.register_server(self)
+
+    # -- socketserver-compatible surface ------------------------------------
+    def start(self) -> "AioHTTPServer":
+        self._thread = threading.Thread(
+            target=self._thread_main, name="aio-serve", daemon=True
+        )
+        self._thread.start()
+        self._ready.wait(timeout=30)
+        if self._startup_error is not None:
+            raise self._startup_error
+        return self
+
+    def shutdown(self) -> None:
+        loop, evt = self._loop, self._stop_evt
+        if loop is None or evt is None or self._stopped:
+            return
+        self._stopped = True
+        try:
+            loop.call_soon_threadsafe(evt.set)
+        except RuntimeError:
+            return  # loop already closed
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def server_close(self) -> None:
+        self.shutdown()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def inflight_count(self) -> int:
+        return len(self._conns)
+
+    def overloaded(self) -> bool:
+        wm = serving_watermark()
+        return wm > 0 and len(self._conns) >= wm
+
+    # -- loop internals ------------------------------------------------------
+    def _thread_main(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        try:
+            loop.run_until_complete(self._main())
+        except Exception as e:
+            if not self._ready.is_set():
+                self._startup_error = e
+                self._ready.set()
+            else:
+                glog.exception("aio serving loop died")
+        finally:
+            try:
+                loop.close()
+            except Exception:  # sweedlint: ok broad-except loop teardown best-effort; process is moving on
+                pass
+
+    async def _main(self) -> None:
+        self._stop_evt = asyncio.Event()
+        try:
+            server = await asyncio.start_server(
+                self._client, self.host, self.port,
+                ssl=self._ssl, limit=1 << 20, backlog=2048,
+            )
+        except BaseException as e:
+            self._startup_error = e
+            self._ready.set()
+            return
+        addr = server.sockets[0].getsockname()
+        self.server_address = (addr[0], addr[1])
+        lag = asyncio.ensure_future(self._lag_monitor())
+        self._ready.set()
+        await self._stop_evt.wait()
+        lag.cancel()
+        server.close()
+        await server.wait_closed()
+        # sever live keep-alive connections, same contract as the
+        # threads core: a stopped server must not keep answering
+        for task in list(self._conn_tasks):
+            task.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+
+    async def _lag_monitor(self) -> None:
+        """Publish scheduled-vs-ran delta: how late a timer fires is how
+        long something hogged the loop (a blocking call the sweedlint
+        blocking-on-loop rule should have caught)."""
+        interval = 0.2
+        while True:
+            t0 = self._loop.time()
+            await asyncio.sleep(interval)
+            SERVING.note_loop_lag(self._loop.time() - t0 - interval)
+
+    async def _pump(self, flume: ThreadFlume, writer) -> None:
+        """Drain the response flume to the transport; on client death,
+        poison the flume so producing workers unwind promptly."""
+        try:
+            async for item in flume:
+                if isinstance(item, _SendfileOp):
+                    try:
+                        await writer.drain()
+                        sent = await self._loop.sendfile(
+                            writer.transport, item.file,
+                            item.offset, item.count, fallback=True,
+                        )
+                    except BaseException as e:
+                        item.reject(e)
+                        raise
+                    item.resolve(sent)
+                else:
+                    writer.write(item)
+                    await writer.drain()
+        except asyncio.CancelledError:
+            flume.close_read()
+            raise
+        except Exception:
+            flume.close_read()
+
+    async def _client(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        try:
+            await self._serve_connection(reader, writer)
+        finally:
+            self._conn_tasks.discard(task)
+
+    async def _serve_connection(self, reader, writer) -> None:
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            try:
+                sock.setsockopt(
+                    _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+                )
+            except OSError:
+                pass
+        wm = serving_watermark()
+        if wm > 0 and len(self._conns) >= wm:
+            SERVING.note_rejected()
+            try:
+                writer.write(admission_reject_response())
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            writer.close()
+            return
+        self._conns.add(writer)
+        flume = ThreadFlume(self._loop, window=8)
+        pump = asyncio.ensure_future(self._pump(flume, writer))
+        rfile = _RfileBridge(self._loop, reader)
+        wfile = _WfileBridge(flume)
+        conn = _ShimConn(rfile, flume)
+        peer = writer.get_extra_info("peername") or ("", 0)
+        try:
+            while True:
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except asyncio.IncompleteReadError:
+                    break  # clean idle close (or torn mid-head: moot)
+                except asyncio.LimitOverrunError:
+                    await self._canned(
+                        flume, pump, writer,
+                        b"HTTP/1.1 431 Request Header Fields Too Large"
+                        b"\r\nContent-Length: 0\r\n"
+                        b"Connection: close\r\n\r\n",
+                    )
+                    break
+                except (ConnectionError, OSError):
+                    break
+                idx = head.find(b"\r\n")
+                raw_requestline = head[: idx + 2]
+                rfile.set_head(head[idx + 2:])
+                try:
+                    close = await self._loop.run_in_executor(
+                        self._pool, _run_request,
+                        self.handler_cls, self, conn, rfile, wfile,
+                        (peer[0], peer[1] if len(peer) > 1 else 0),
+                        raw_requestline,
+                    )
+                except RuntimeError:
+                    break  # worker pool already shut down: server stopping
+                if close:
+                    break
+        except asyncio.CancelledError:
+            pass  # server teardown severs this connection
+        finally:
+            # normal close: let the pump DRAIN queued response bytes
+            # (close marks end-of-stream) before poisoning; poisoning
+            # first would truncate the final keep-alive response
+            flume.close()
+            try:
+                await asyncio.wait_for(asyncio.shield(pump), timeout=15)
+            except BaseException:
+                # wedged or cancelled pump; the connection dies either way
+                pump.cancel()
+            flume.close_read()  # unblock any producer thread still stuck
+            try:
+                await pump
+            except BaseException:  # sweedlint: ok broad-except pump already poisoned the flume; connection is closing
+                pass
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:  # sweedlint: ok broad-except transport may already be gone
+                pass
+
+    async def _canned(self, flume, pump, writer, payload: bytes) -> None:
+        """Loop-originated error response: let the pump finish what is
+        queued first so bytes stay ordered, then write directly."""
+        flume.close()
+        try:
+            await pump
+        except Exception:
+            # pump failure means the peer is gone; the canned reply is moot
+            return
+        try:
+            writer.write(payload)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass

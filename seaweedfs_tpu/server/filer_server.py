@@ -34,6 +34,7 @@ import urllib.parse
 from typing import Optional
 
 from ..util.locks import lock_stats, make_lock
+from ..stats import serving_stats
 from .. import operation
 from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import MAX_INT64, view_from_chunks
@@ -147,6 +148,123 @@ class _FidBatch:
             return self._pending.pop()
 
 
+class _AssignCoalescer:
+    """Single-flight batching of concurrent single-fid assigns: under a
+    smallfile write storm every request thread used to fire its own
+    ``/dir/assign`` at the master — N round-trips for N needle keys the
+    sequencer could have reserved in one bump. Here the first caller in a
+    quiet period LEADS: it issues the RPC immediately (an uncontended
+    assign pays zero added latency — no timers), and callers that arrive
+    while that RPC is in flight queue up; the leader keeps issuing
+    ``assign(count=len(queue))`` rounds until the queue is empty.
+
+    Fid fan-out mirrors ``_FidBatch``: the master token covers only the
+    base fid, so ``base_<delta>`` suffixes are self-signed with the
+    filer's key. When the cluster enforces auth and this filer holds no
+    signing key, only the base fid is usable — the leader takes it and
+    the other waiters are released to do their own single assigns
+    (correct, just uncoalesced).
+    """
+
+    def __init__(self, fs: "FilerServer"):
+        self._fs = fs
+        self._lock = make_lock("_AssignCoalescer._lock")
+        self._queues: dict = {}  # key → list of waiter dicts
+        self._leading: set = set()  # keys with an RPC loop running
+
+    def assign(self, collection: str, replication: str, ttl: str):
+        key = (collection, replication, ttl)
+        w = {"evt": threading.Event(), "a": None, "err": None,
+             "solo": False, "promote": False}
+        with self._lock:
+            self._queues.setdefault(key, []).append(w)
+            lead = key not in self._leading
+            if lead:
+                self._leading.add(key)
+        if lead:
+            self._lead_round(key)
+        while True:
+            if not w["evt"].wait(timeout=60.0):
+                raise RuntimeError("coalesced assign timed out")
+            if w["promote"]:
+                # leadership handoff: the previous leader served its round
+                # and left; we (still unserved) run the next round — no
+                # caller ever issues more than one RPC for the group
+                w["promote"] = False
+                w["evt"].clear()
+                self._lead_round(key)
+                continue
+            break
+        if w["err"] is not None:
+            raise w["err"]
+        if w["solo"]:
+            # auth cluster without a filer signing key: suffix fids are
+            # unusable, go get a dedicated one
+            return operation.assign(
+                self._fs.master_url, collection=collection,
+                replication=replication, ttl=ttl,
+            )
+        return w["a"]
+
+    def _lead_round(self, key) -> None:
+        collection, replication, ttl = key
+        with self._lock:
+            waiters = self._queues.pop(key, [])
+            if not waiters:
+                self._leading.discard(key)
+                return
+        try:
+            a = operation.assign(
+                self._fs.master_url, count=len(waiters),
+                collection=collection, replication=replication, ttl=ttl,
+            )
+        except Exception as e:
+            for w in waiters:
+                w["err"] = e
+                w["evt"].set()
+            self._handoff(key)
+            return
+        got = max(1, a.count)
+        usable = got if (not a.auth or self._fs.jwt_signing_key) else 1
+        usable = min(usable, len(waiters))
+        from .http_util import SERVING
+
+        SERVING.note_assign_batch(usable)
+        waiters[0]["a"] = a
+        waiters[0]["evt"].set()
+        for i, w in enumerate(waiters[1:], start=1):
+            if i >= usable:
+                w["solo"] = True
+                w["evt"].set()
+                continue
+            fid = f"{a.fid}_{i}"
+            auth = ""
+            if a.auth:
+                from ..security import gen_jwt
+
+                auth = gen_jwt(self._fs.jwt_signing_key, fid)
+            w["a"] = operation.Assignment(
+                fid=fid, url=a.url, public_url=a.public_url,
+                count=1, auth=auth,
+            )
+            w["evt"].set()
+        self._handoff(key)
+
+    def _handoff(self, key) -> None:
+        """End of a round: pass leadership to a queued waiter, or step
+        down. The enqueue in ``assign`` and this check share one lock, so
+        a waiter either made this round's grab, gets promoted here, or
+        (arriving after the discard) elects itself."""
+        with self._lock:
+            nxt = self._queues.get(key)
+            if not nxt:
+                self._queues.pop(key, None)
+                self._leading.discard(key)
+                return
+            nxt[0]["promote"] = True
+            nxt[0]["evt"].set()
+
+
 class FilerServer:
     def __init__(
         self,
@@ -196,6 +314,8 @@ class FilerServer:
         self.replication = replication
         self.cipher = cipher
         self.manifest_batch = manifest_batch
+        # single-flight batcher for the per-request assign storm
+        self._assign_coalescer = _AssignCoalescer(self)
         # data-plane pipeline depths (util/pipeline.py): N-deep chunk
         # read-ahead on GET, N uploads in flight on PUT; 1 = serial. Peak
         # extra memory per request is window × chunk_size (docs/PERF.md)
@@ -306,14 +426,23 @@ class FilerServer:
         """AssignVolume rpc analog (pb/filer.proto): mount and other write-
         through clients get fids + upload urls without talking to the
         master themselves."""
+        count = self._qint(q, "count", 1)
         try:
-            a = operation.assign(
-                self.master_url,
-                count=self._qint(q, "count", 1),
-                collection=q.get("collection", self.collection),
-                replication=q.get("replication", self.replication),
-                ttl=q.get("ttl", ""),
-            )
+            if count <= 1:
+                # single-fid asks ride the coalescer with the write path
+                a = self._assign_coalescer.assign(
+                    q.get("collection", self.collection),
+                    q.get("replication", self.replication),
+                    q.get("ttl", ""),
+                )
+            else:
+                a = operation.assign(
+                    self.master_url,
+                    count=count,
+                    collection=q.get("collection", self.collection),
+                    replication=q.get("replication", self.replication),
+                    ttl=q.get("ttl", ""),
+                )
         except Exception as e:
             return 500, {"error": str(e)}
         return 200, {
@@ -396,6 +525,9 @@ class FilerServer:
             # scan-engine counters (rows/bytes through /_query and
             # /_select plans, kernel vs exact-lane split)
             "query": self._query_stats(),
+            # serving-core counters: mode, inflight connections,
+            # admission shedding, loop lag, coalesced-assign batch shape
+            "serving": serving_stats(),
         }
 
     def _h_metrics(self, h, path, q, body):
@@ -628,11 +760,10 @@ class FilerServer:
     def _upload_piece(self, piece: bytes, offset: int, collection: str,
                       replication: str, ttl: str, use_cipher: bool,
                       assigner=None, record=None) -> FileChunk:
-        a = assigner() if assigner is not None else operation.assign(
-            self.master_url,
-            collection=collection,
-            replication=replication,
-            ttl=ttl,
+        a = (
+            assigner()
+            if assigner is not None
+            else self._assign_coalescer.assign(collection, replication, ttl)
         )
         if record is not None:
             # record BEFORE uploading: a piece that fails (or crashes) mid-
@@ -776,9 +907,7 @@ class FilerServer:
         use_cipher: bool,
     ) -> FileChunk:
         """Assign + upload one blob; used for manifest chunks."""
-        a = operation.assign(
-            self.master_url, collection=collection, replication=replication, ttl=ttl
-        )
+        a = self._assign_coalescer.assign(collection, replication, ttl)
         cipher_key_b64 = ""
         payload = blob
         if use_cipher:

@@ -3,13 +3,164 @@
 from __future__ import annotations
 
 import json
+import os
 import threading
-import urllib.parse
-import urllib.request
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
+import urllib.parse
+import urllib.request
+
 from seaweedfs_tpu.util import glog
+
+
+# -- serving-core shared state ------------------------------------------------
+def serving_mode() -> str:
+    """'aio' or 'threads' — which serving core start_server builds."""
+    mode = os.environ.get("SWEED_SERVING", "threads").strip().lower()
+    return "aio" if mode == "aio" else "threads"
+
+
+def serving_watermark() -> int:
+    """Inflight-connection admission watermark (0 disables shedding).
+
+    Read per call so tests can raise/lower it around a live server; the
+    default is high enough that only genuine connection storms shed."""
+    raw = os.environ.get("SWEED_MAX_INFLIGHT", "8192").strip()
+    if not (raw.isascii() and raw.isdigit()):
+        return 8192
+    return int(raw)
+
+
+def retry_after_seconds() -> int:
+    raw = os.environ.get("SWEED_RETRY_AFTER", "1").strip()
+    if not (raw.isascii() and raw.isdigit()):
+        return 1
+    return max(1, int(raw))
+
+
+def sendfile_min_bytes() -> Optional[int]:
+    """Data-size floor for the zero-copy GET path, or None when disabled.
+
+    Small needles lose more to the extra metadata reads + fd dup than
+    the copy costs; the default floor keeps sendfile for the bodies
+    where it pays. ``SWEED_SENDFILE=0`` disables the path outright."""
+    if os.environ.get("SWEED_SENDFILE", "1").strip() == "0":
+        return None
+    raw = os.environ.get("SWEED_SENDFILE_MIN", "65536").strip()
+    if not (raw.isascii() and raw.isdigit()):
+        return 65536
+    return int(raw)
+
+
+def admission_reject_response() -> bytes:
+    """Canned 503 written straight to a just-accepted socket when the
+    gateway is past its inflight watermark: the peer learns to back off
+    (Retry-After) without the server spending a handler thread / parsed
+    request on it."""
+    return (
+        "HTTP/1.1 503 Service Unavailable\r\n"
+        f"Retry-After: {retry_after_seconds()}\r\n"
+        "Content-Length: 0\r\n"
+        "Connection: close\r\n\r\n"
+    ).encode("ascii")
+
+
+class _ServingState:
+    """Cross-server serving-core counters backing the ``sweed_serving_*``
+    gauges and the /_status "serving" section. Live servers (threads or
+    aio) register themselves; inflight is summed lazily so the counter
+    can never drift from the per-server truth."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._servers: "weakref.WeakSet" = weakref.WeakSet()
+        self._rejected = 0
+        self._keepalive_shed = 0
+        self._loop_lag_last_ms = 0.0
+        self._loop_lag_max_ms = 0.0
+        self._assign_batches = 0
+        self._assign_fids = 0
+        self._assign_max_batch = 0
+
+    def register_server(self, srv) -> None:
+        with self._lock:
+            self._servers.add(srv)
+
+    def inflight(self) -> int:
+        with self._lock:
+            servers = list(self._servers)
+        total = 0
+        for s in servers:
+            try:
+                total += s.inflight_count()
+            except Exception:  # sweedlint: ok broad-except a dying server mid-teardown must not break the gauge
+                pass
+        return total
+
+    def note_rejected(self) -> None:
+        with self._lock:
+            self._rejected += 1
+
+    def note_keepalive_shed(self) -> None:
+        with self._lock:
+            self._keepalive_shed += 1
+
+    def note_loop_lag(self, seconds: float) -> None:
+        ms = max(0.0, seconds * 1000.0)
+        with self._lock:
+            self._loop_lag_last_ms = ms
+            if ms > self._loop_lag_max_ms:
+                self._loop_lag_max_ms = ms
+
+    def note_assign_batch(self, n: int) -> None:
+        with self._lock:
+            self._assign_batches += 1
+            self._assign_fids += n
+            if n > self._assign_max_batch:
+                self._assign_max_batch = n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            batches = self._assign_batches
+            return {
+                "mode": serving_mode(),
+                "watermark": serving_watermark(),
+                "inflight": self.inflight_unlocked_sum(),
+                "admission_rejected": self._rejected,
+                "keepalive_shed": self._keepalive_shed,
+                "loop_lag_ms": round(self._loop_lag_last_ms, 3),
+                "loop_lag_max_ms": round(self._loop_lag_max_ms, 3),
+                "assign_batches": batches,
+                "assign_fids": self._assign_fids,
+                "assign_max_batch": self._assign_max_batch,
+                "assign_avg_batch": round(
+                    self._assign_fids / batches, 2
+                ) if batches else 0.0,
+            }
+
+    def inflight_unlocked_sum(self) -> int:
+        # callers hold self._lock; per-server counts use their own locks
+        total = 0
+        for s in list(self._servers):
+            try:
+                total += s.inflight_count()
+            except Exception:  # sweedlint: ok broad-except a dying server mid-teardown must not break the gauge
+                pass
+        return total
+
+
+SERVING = _ServingState()
+
+
+def serving_overloaded(handler) -> bool:
+    """True when the handler's server is past its admission watermark;
+    used to propagate backpressure to keep-alive clients (the reply gets
+    Connection: close so the pooled peer re-dials into admission)."""
+    srv = getattr(handler, "server", None)
+    fn = getattr(srv, "overloaded", None)
+    return bool(fn()) if fn is not None else False
 
 
 def relay_stream(handler, payload, declared_len: Optional[int] = None) -> None:
@@ -103,6 +254,27 @@ class StreamBody:
     def __init__(self, length: int, chunks):
         self.length = length
         self.chunks = chunks
+
+
+class SendfileBody:
+    """Handler return value for zero-copy responses: ``count`` bytes at
+    ``offset`` of ``file`` (a real OS file, typically a dup of a volume's
+    .dat fd) go to the client socket via sendfile(2) — no userspace copy.
+
+    Threads mode relays with ``socket.sendfile`` (which falls back to a
+    send loop on TLS sockets); the aio reactor uses ``loop.sendfile``.
+    The receiver always closes ``file``."""
+
+    def __init__(self, file, offset: int, count: int):
+        self.file = file
+        self.offset = offset
+        self.count = count
+
+    def close(self) -> None:
+        try:
+            self.file.close()
+        except OSError:
+            pass
 
 
 def has_dot_segments(path: str) -> bool:
@@ -209,9 +381,22 @@ class JsonHandler(BaseHTTPRequestHandler):
                 left -= len(got)
         self._reply(404, {"error": f"no route {method} {parsed.path}"})
 
+    def _shed_keepalive_if_overloaded(self) -> None:
+        """Past the admission watermark, tell keep-alive peers to go away
+        after this response: Connection: close drains established pools
+        back through admission instead of letting pre-watermark clients
+        hold their slots forever."""
+        if serving_overloaded(self):
+            self.send_header("Connection", "close")
+            self.close_connection = True
+            SERVING.note_keepalive_shed()
+
     def _reply(self, status: int, payload, head_only: bool = False) -> None:
         if isinstance(payload, StreamBody):
             self._reply_stream(status, payload, head_only)
+            return
+        if isinstance(payload, SendfileBody):
+            self._reply_sendfile(status, payload, head_only)
             return
         if isinstance(payload, (bytes, bytearray)):
             data = bytes(payload)
@@ -232,6 +417,7 @@ class JsonHandler(BaseHTTPRequestHandler):
         for k, v in (self.extra_headers or {}).items():
             self.send_header(k, v)
         self.extra_headers = None
+        self._shed_keepalive_if_overloaded()
         self.end_headers()
         if not head_only:  # HEAD: headers only, or keep-alive framing breaks
             try:
@@ -240,6 +426,51 @@ class JsonHandler(BaseHTTPRequestHandler):
                 # peer vanished mid-reply (e.g. aborted its own upload);
                 # nothing to salvage — just stop reusing the socket
                 self.close_connection = True
+
+    def _reply_sendfile(self, status: int, body: "SendfileBody",
+                        head_only: bool) -> None:
+        """Zero-copy reply: headers through the normal path, then the
+        needle's data region goes kernel→socket via sendfile(2). The
+        shim connection of the aio reactor implements the same
+        ``connection.sendfile(file, offset=, count=)`` surface with
+        ``loop.sendfile``, so this code serves both modes."""
+        self.send_response(status)
+        ctype = "application/octet-stream"
+        if self.extra_headers and "Content-Type" in self.extra_headers:
+            ctype = self.extra_headers.pop("Content-Type")
+        self.send_header("Content-Type", ctype)
+        clen = str(body.count)
+        if self.extra_headers and "Content-Length" in self.extra_headers:
+            clen = self.extra_headers.pop("Content-Length")
+        self.send_header("Content-Length", clen)
+        for k, v in (self.extra_headers or {}).items():
+            self.send_header(k, v)
+        self.extra_headers = None
+        self._shed_keepalive_if_overloaded()
+        self.end_headers()
+        if head_only:
+            body.close()
+            return
+        sent = 0
+        try:
+            self.wfile.flush()  # headers first — sendfile bypasses wfile
+            sent = self.connection.sendfile(
+                body.file, offset=body.offset, count=body.count
+            ) or 0
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+            return
+        except Exception:
+            glog.exception("sendfile reply failed after %d/%d bytes",
+                           sent, body.count)
+            self.close_connection = True
+            return
+        finally:
+            body.close()
+        if sent != body.count:
+            glog.error("sendfile reply produced %d of %d bytes", sent,
+                       body.count)
+            self.close_connection = True
 
     def _reply_stream(self, status: int, body: "StreamBody",
                       head_only: bool) -> None:
@@ -255,6 +486,7 @@ class JsonHandler(BaseHTTPRequestHandler):
         for k, v in (self.extra_headers or {}).items():
             self.send_header(k, v)
         self.extra_headers = None
+        self._shed_keepalive_if_overloaded()
         self.end_headers()
         if head_only:
             return
@@ -327,20 +559,65 @@ def unsatisfiable_range_headers(total: int) -> dict:
     return {"Content-Range": f"bytes */{total}"}
 
 
+def _close_socket(sock) -> None:
+    import socket as _socket
+
+    try:
+        sock.shutdown(_socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 class _TrackingThreadingHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that severs live keep-alive connections on
-    shutdown. Without this a 'stopped' server keeps answering requests on
-    established connections (handler threads block in readline forever) —
-    clients with pooled connections then talk to a ghost."""
+    shutdown, with inflight-watermark admission control. Without the
+    sever, a 'stopped' server keeps answering requests on established
+    connections (handler threads block in readline forever) — clients
+    with pooled connections then talk to a ghost."""
 
     def __init__(self, *a, **k):
         super().__init__(*a, **k)
         self._live_conns: set = set()
         self._conns_lock = threading.Lock()
+        # flipped under _conns_lock by shutdown(); any connection that
+        # would register after the sever pass is closed instead of
+        # becoming an untracked ghost (the PR 7 shutdown-race fix)
+        self._shutting_down = False
+        SERVING.register_server(self)
+
+    def inflight_count(self) -> int:
+        with self._conns_lock:
+            return len(self._live_conns)
+
+    def overloaded(self) -> bool:
+        wm = serving_watermark()
+        return wm > 0 and self.inflight_count() >= wm
 
     def process_request(self, request, client_address):
+        wm = serving_watermark()
         with self._conns_lock:
-            self._live_conns.add(request)
+            if self._shutting_down:
+                # raced shutdown(): the sever pass may already have run,
+                # so registering now would leak an unclosed connection
+                _close_socket(request)
+                return
+            if wm > 0 and len(self._live_conns) >= wm:
+                reject = True
+            else:
+                self._live_conns.add(request)
+                reject = False
+        if reject:
+            SERVING.note_rejected()
+            try:
+                request.sendall(admission_reject_response())
+            except OSError:
+                pass
+            _close_socket(request)
+            return
         super().process_request(request, client_address)
 
     def shutdown_request(self, request):
@@ -350,25 +627,27 @@ class _TrackingThreadingHTTPServer(ThreadingHTTPServer):
 
     def shutdown(self):
         super().shutdown()
-        import socket as _socket
-
         with self._conns_lock:
+            self._shutting_down = True
             conns = list(self._live_conns)
             self._live_conns.clear()
         for c in conns:
-            try:
-                c.shutdown(_socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                c.close()
-            except OSError:
-                pass
+            _close_socket(c)
 
 
-def start_server(
-    handler_cls, host: str, port: int, ssl_context=None
-) -> ThreadingHTTPServer:
+def start_server(handler_cls, host: str, port: int, ssl_context=None):
+    """A serving core for `handler_cls` on (host, port): the classic
+    thread-per-connection `ThreadingHTTPServer`, or — with
+    ``SWEED_SERVING=aio`` — the asyncio reactor (`server/aio.py`), which
+    runs the exact same handler code but parks idle connections on the
+    event loop instead of spending a thread each. Both expose
+    shutdown()/server_close()/server_address and admission control."""
+    if serving_mode() == "aio":
+        from .aio import AioHTTPServer
+
+        return AioHTTPServer(
+            handler_cls, host, port, ssl_context=ssl_context
+        ).start()
     if ssl_context is None:
         srv = _TrackingThreadingHTTPServer((host, port), handler_cls)
     else:
@@ -396,6 +675,12 @@ def start_server(
                 # process_request — track the live TLS socket instead or
                 # shutdown() severs a dead fd and the ghost lives on
                 with self._conns_lock:
+                    if self._shutting_down:
+                        # same shutdown race as process_request: the
+                        # sever pass already ran in another thread, so
+                        # the swapped-in TLS socket must die here
+                        _close_socket(tls_conn)
+                        return
                     self._live_conns.discard(request)
                     self._live_conns.add(tls_conn)
                 try:

@@ -21,6 +21,7 @@ from typing import Optional
 
 from ..cluster.master import Master
 from ..cluster.topology import DataNode
+from ..stats import serving_stats
 from ..util import glog
 from ..util.parsers import tolerant_ufloat, tolerant_uint
 from .http_util import JsonHandler, http_json, start_server
@@ -251,6 +252,8 @@ class MasterServer:
             # OrderedLock sanitizer counters + observed order edges
             # (all-zero unless the process runs with SWEED_LOCK_CHECK=1)
             "locks": lock_stats(),
+            # serving-core counters (mode, inflight, admission shedding)
+            "serving": serving_stats(),
         }
 
     def _h_ui(self, h, path, q, body):

@@ -190,14 +190,29 @@ class VolumeServer:
         with self._req_hist.time(op="get"):
             vid, nid, cookie = self._parse_fid_path(path)
             n = Needle(id=nid)
+            ext = None
             try:
-                self.store.read_volume_needle(vid, n)
+                ext = self._needle_extent(q, vid, n)
+                if ext is None:
+                    self.store.read_volume_needle(vid, n)
             except (NotFoundError, Exception) as e:
                 if isinstance(e, (NotFoundError, DeletedError)) or "not in ecx" in str(e):
                     return 404, {"error": str(e)}
                 raise
             if n.cookie != cookie:
+                if ext is not None:
+                    ext[0].close()
                 return 404, {"error": "cookie mismatch"}
+            if ext is not None:
+                resp = self._sendfile_reply(h, q, n, ext)
+                if resp is not None:
+                    return resp
+                # disqualified only after the metadata parse (chunk
+                # manifest / client won't take gzip): buffered re-read
+                try:
+                    self.store.read_volume_needle(vid, n)
+                except (NotFoundError, DeletedError) as e:
+                    return 404, {"error": str(e)}
             data = bytes(n.data)
             if n.is_chunk_manifest and q.get("cm") != "false":
                 # server-side chunked-file resolution
@@ -244,6 +259,59 @@ class VolumeServer:
                 "Accept-Ranges": "bytes"
             }
             return 200, data
+
+    def _needle_extent(self, q: dict, vid: int, n: Needle):
+        """Try the zero-copy read setup (Store.read_volume_needle_extent).
+        None → take the buffered path; ``?width/height`` resizes need the
+        bytes in userspace, so those requests never qualify."""
+        from .http_util import sendfile_min_bytes
+
+        min_size = sendfile_min_bytes()
+        if min_size is None:
+            return None
+        if tolerant_uint(q.get("width"), None) or tolerant_uint(
+            q.get("height"), None
+        ):
+            return None
+        return self.store.read_volume_needle_extent(vid, n, min_size)
+
+    def _sendfile_reply(self, h, q, n: Needle, ext):
+        """Build the zero-copy reply for a qualified extent, or close the
+        file and return None when the parsed metadata disqualifies it
+        (chunk manifest to resolve; gzip the client didn't ask for)."""
+        from .http_util import (
+            SendfileBody,
+            parse_byte_range,
+            range_headers,
+            unsatisfiable_range_headers,
+        )
+
+        f, data_off, data_len = ext
+        if n.is_chunk_manifest and q.get("cm") != "false":
+            f.close()
+            return None
+        serving_gzip = False
+        if n.is_compressed:
+            if "gzip" in h.headers.get("Accept-Encoding", ""):
+                serving_gzip = True
+            else:
+                f.close()
+                return None
+        rng = h.headers.get("Range", "")
+        if rng and not serving_gzip:  # ranges address the plaintext bytes
+            parsed = parse_byte_range(rng, data_len)
+            if parsed == "unsatisfiable":
+                f.close()
+                h.extra_headers = unsatisfiable_range_headers(data_len)
+                return 416, b""
+            if parsed is not None:
+                start, end = parsed
+                h.extra_headers = range_headers(start, end, data_len)
+                return 206, SendfileBody(f, data_off + start, end - start + 1)
+        h.extra_headers = {"Accept-Ranges": "bytes"}
+        if serving_gzip:
+            h.extra_headers["Content-Encoding"] = "gzip"
+        return 200, SendfileBody(f, data_off, data_len)
 
     @staticmethod
     def _range_reply(h, data: bytes, rng: str):

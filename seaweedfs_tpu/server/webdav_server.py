@@ -21,15 +21,13 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 from datetime import datetime, timezone
-from http.server import BaseHTTPRequestHandler
-
 from ..filer.client import FilerClient
 from ..util.safe_xml import safe_fromstring
 from .http_util import (
     CountedReader,
+    JsonHandler,
+    StreamBody,
     drain_refused_body,
-    parse_content_length,
-    relay_stream,
     start_server,
 )
 
@@ -547,103 +545,106 @@ class WebDavServer:
 
     # --------------------------------------------------------------- lifecycle
     def start(self):
+        """Serve through the shared JsonHandler infrastructure (routing,
+        tolerant Content-Length parsing, streaming bodies, keep-alive and
+        admission behavior) instead of a bespoke handler — the dav
+        ``do_<method>(path, headers, body) → (status, payload, extra)``
+        convention is adapted onto routes below."""
         dav = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            disable_nagle_algorithm = True  # keep-alive + Nagle = ~40ms RTTs
+        def pieces(reader, length: int):
+            """File-like upstream body → the bounded chunk iterable
+            StreamBody wants (relay discipline lives in _reply_stream)."""
+            left = length
+            try:
+                while left > 0:
+                    got = reader.read(min(1 << 20, left))
+                    if not got:
+                        break
+                    left -= len(got)
+                    yield got
+            finally:
+                reader.close()
+
+        def finish(h, result):
+            """Map a dav (status, payload, extra) onto the JsonHandler
+            reply surface: Content-Length-Override → the _reply override
+            header, file-like payloads → StreamBody."""
+            status, payload, extra = result
+            clen = extra.pop("Content-Length-Override", None)
+            if hasattr(payload, "read"):
+                h.extra_headers = extra or None
+                length = int(clen)
+                return status, StreamBody(length, pieces(payload, length))
+            if clen is not None:
+                extra["Content-Length"] = clen
+            h.extra_headers = extra or None
+            return status, bytes(payload)
+
+        def route(fn):
+            def handle(h, path, q, body):
+                headers = {k.title(): v for k, v in h.headers.items()}
+                return finish(h, fn(path, headers, body))
+
+            return handle
+
+        @JsonHandler.mark_streaming
+        def put_route(h, path, q, rfile, length):
+            headers = {k.title(): v for k, v in h.headers.items()}
+            reader = CountedReader(rfile, length)
+            try:
+                result = dav.do_put(path, headers, (reader, length))
+            finally:
+                if reader.left > 0:
+                    # refused before the body was consumed: bounded,
+                    # timeout-guarded drain keeps keep-alive framing
+                    drain_refused_body(h, reader)
+            return finish(h, result)
+
+        class Handler(JsonHandler):
+            server_ctx = dav
+            routes = [
+                ("OPTIONS", "/", route(dav.do_options)),
+                ("PROPFIND", "/", route(dav.do_propfind)),
+                ("MKCOL", "/", route(dav.do_mkcol)),
+                ("GET", "/", route(dav.do_get)),
+                ("HEAD", "/",
+                 route(lambda p, hd, b: dav.do_get(p, hd, b, head=True))),
+                ("PUT", "/", put_route),
+                ("DELETE", "/", route(dav.do_delete)),
+                ("MOVE", "/", route(dav.do_move)),
+                ("COPY", "/", route(dav.do_copy)),
+                ("PROPPATCH", "/", route(dav.do_proppatch)),
+                ("LOCK", "/", route(dav.do_lock)),
+                ("UNLOCK", "/", route(dav.do_unlock)),
+            ]
 
             def log_message(self, fmt, *args):
                 pass
 
-            def _go(self, method):
-                parsed = urllib.parse.urlparse(self.path)
-                length = parse_content_length(self.headers)
-                if length < 0:
-                    # framing is unknowable → 400 and drop the connection
-                    self.close_connection = True
-                    self.send_response(400)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                reader = None
-                if method == "PUT":
-                    # stream PUT bodies straight through to the filer
-                    reader = CountedReader(self.rfile, length)
-                    body = (reader, length)
-                else:
-                    body = self.rfile.read(length) if length else b""
-                headers = {k.title(): v for k, v in self.headers.items()}
-                if method == "HEAD":
-                    fn = lambda p, h, b: dav.do_get(p, h, b, head=True)  # noqa: E731
-                else:
-                    fn = getattr(dav, f"do_{method.lower()}", None)
-                if fn is None:
-                    status, payload, extra = 405, b"", {}
-                else:
-                    try:
-                        status, payload, extra = fn(parsed.path, headers, body)
-                    except Exception as e:  # noqa: BLE001
-                        status, payload, extra = 500, str(e).encode(), {}
-                if reader is not None and reader.left > 0:
-                    # refused before the body was consumed: bounded,
-                    # timeout-guarded drain (http_util.drain_refused_body)
-                    drain_refused_body(self, reader)
-                self.send_response(status)
-                streaming = hasattr(payload, "read")
-                clen = extra.pop("Content-Length-Override", None)
-                if "Content-Type" not in extra and (payload or streaming):
-                    extra["Content-Type"] = "application/octet-stream"
-                self.send_header(
-                    "Content-Length",
-                    clen if streaming else (clen or str(len(payload))),
-                )
-                for k, v in extra.items():
-                    self.send_header(k, v)
-                self.end_headers()
-                if streaming:
-                    if method == "HEAD":
-                        payload.close()
-                    else:
-                        relay_stream(self, payload, int(clen))
-                elif method != "HEAD" and payload:
-                    self.wfile.write(payload)
-
             def do_OPTIONS(self):
-                self._go("OPTIONS")
+                self._dispatch("OPTIONS")
 
             def do_PROPFIND(self):
-                self._go("PROPFIND")
+                self._dispatch("PROPFIND")
 
             def do_MKCOL(self):
-                self._go("MKCOL")
-
-            def do_GET(self):
-                self._go("GET")
-
-            def do_HEAD(self):
-                self._go("HEAD")
-
-            def do_PUT(self):
-                self._go("PUT")
-
-            def do_DELETE(self):
-                self._go("DELETE")
+                self._dispatch("MKCOL")
 
             def do_MOVE(self):
-                self._go("MOVE")
+                self._dispatch("MOVE")
 
             def do_COPY(self):
-                self._go("COPY")
+                self._dispatch("COPY")
 
             def do_PROPPATCH(self):
-                self._go("PROPPATCH")
+                self._dispatch("PROPPATCH")
 
             def do_LOCK(self):
-                self._go("LOCK")
+                self._dispatch("LOCK")
 
             def do_UNLOCK(self):
-                self._go("UNLOCK")
+                self._dispatch("UNLOCK")
 
         from ..security.tls import optional_server_context
 

@@ -12,4 +12,5 @@ from .metrics import (  # noqa: F401
     disk_status,
     memory_status,
     query_stats,
+    serving_stats,
 )

@@ -201,6 +201,63 @@ def register_lock_metrics(registry: Optional[Registry] = None) -> None:
 register_lock_metrics()
 
 
+def register_serving_metrics(registry: Optional[Registry] = None) -> None:
+    """Gauges over the serving-core state (server/http_util.SERVING):
+    inflight connections across live servers, admission-control
+    rejections, event-loop lag, and coalesced-assign batch shape."""
+
+    def _snap(key):
+        # lazy import: stats must not pull the server package at import
+        # time (MetricsPusher.push_once precedent)
+        from ..server.http_util import SERVING
+
+        return SERVING.snapshot().get(key, 0)
+
+    reg = registry if registry is not None else default_registry
+    reg.gauge(
+        "sweed_serving_inflight",
+        "connections currently inside live HTTP servers",
+    ).set_function(lambda: _snap("inflight"))
+    reg.gauge(
+        "sweed_serving_admission_rejected_total",
+        "connections shed with 503 + Retry-After at the watermark",
+    ).set_function(lambda: _snap("admission_rejected"))
+    reg.gauge(
+        "sweed_serving_keepalive_shed_total",
+        "keep-alive replies downgraded to Connection: close while overloaded",
+    ).set_function(lambda: _snap("keepalive_shed"))
+    reg.gauge(
+        "sweed_serving_loop_lag_ms",
+        "event-loop scheduling lag, last sample (aio mode)",
+    ).set_function(lambda: _snap("loop_lag_ms"))
+    reg.gauge(
+        "sweed_serving_loop_lag_max_ms",
+        "worst event-loop scheduling lag observed (aio mode)",
+    ).set_function(lambda: _snap("loop_lag_max_ms"))
+    reg.gauge(
+        "sweed_serving_assign_batches_total",
+        "coalesced master assign RPC rounds",
+    ).set_function(lambda: _snap("assign_batches"))
+    reg.gauge(
+        "sweed_serving_assign_fids_total",
+        "fids handed out through coalesced assign rounds",
+    ).set_function(lambda: _snap("assign_fids"))
+    reg.gauge(
+        "sweed_serving_assign_max_batch",
+        "largest coalesced assign batch observed",
+    ).set_function(lambda: _snap("assign_max_batch"))
+
+
+register_serving_metrics()
+
+
+def serving_stats() -> dict:
+    """Snapshot of the serving-core counters for /_status."""
+    from ..server.http_util import SERVING
+
+    return SERVING.snapshot()
+
+
 def register_query_metrics(
     registry: Optional[Registry] = None,
 ) -> dict[str, Counter]:

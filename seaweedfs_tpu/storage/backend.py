@@ -99,6 +99,11 @@ class DiskFile(BackendStorageFile):
     def name(self) -> str:
         return self._path
 
+    def fileno(self) -> int:
+        """Raw fd for the zero-copy (sendfile) read path; callers dup it
+        under the volume lock before handing it to a socket relay."""
+        return self._f.fileno()
+
     def sync(self) -> None:
         with self._lock:
             self._f.flush()

@@ -280,6 +280,15 @@ class Store:
             return self.read_ec_shard_needle(ev, n)
         raise NotFoundError(f"volume {vid} not found")
 
+    def read_volume_needle_extent(self, vid: int, n: Needle, min_size: int = 0):
+        """Zero-copy read setup for plain volumes (Volume.read_needle_extent);
+        EC-striped data has no contiguous on-disk extent → None (callers
+        fall back to the buffered read)."""
+        v = self.find_volume(vid)
+        if v is None:
+            return None
+        return v.read_needle_extent(n, min_size)
+
     # -- EC encode: crash-safe two-phase commit ------------------------------
     def ec_encode_volume(self, vid: int) -> list[int]:
         """Stripe a sealed volume into 14 shards + .ecx + .vif with an

@@ -19,6 +19,7 @@ writes are synchronous and `sync()` is explicit (callers batch).
 from __future__ import annotations
 
 import os
+import struct
 import threading
 import time
 from typing import Callable, Iterator, Optional
@@ -546,6 +547,87 @@ class Volume:
         if time.time() < n.last_modified + n.ttl.minutes() * 60:
             return len(n.data)
         raise NotFoundError(f"needle {n.id:x} expired")
+
+    def read_needle_extent(
+        self, n: Needle, min_size: int = 0
+    ) -> Optional[tuple]:
+        """Zero-copy read setup: parse everything EXCEPT the data region.
+
+        Returns ``(file, data_offset, data_len)`` where ``file`` is an
+        independent dup of the .dat fd positioned nowhere in particular
+        (the caller sendfiles from ``data_offset`` and must close it), or
+        ``None`` when the record does not qualify — non-disk backend, v1
+        layout, empty needle, below ``min_size``, or any parse
+        irregularity — in which case the caller falls back to the
+        buffered ``read_needle`` path, which also produces the proper
+        error for corrupt records.
+
+        NotFound/Deleted/expired raise exactly as ``read_needle`` does.
+        ``n``'s metadata fields (cookie, flags, name, mime, ttl, …) are
+        populated; ``n.data`` stays empty. The data CRC is NOT verified
+        on this path (see docs/PARITY.md) — the bytes go straight from
+        the page cache to the socket.
+        """
+        with self._lock:
+            if self.version == 1:
+                return None
+            backend_fileno = getattr(self.data_backend, "fileno", None)
+            if backend_fileno is None:
+                return None
+            nv = self.nm.get(n.id)
+            if nv is None or nv.offset == 0:
+                raise NotFoundError(f"needle {n.id:x} not found")
+            read_size = nv.size
+            if read_size < 0:
+                raise DeletedError(f"needle {n.id:x} deleted")
+            if read_size == 0:
+                return None
+            head = self.data_backend.read_at(nv.offset, NEEDLE_HEADER_SIZE + 4)
+            if len(head) < NEEDLE_HEADER_SIZE + 4:
+                return None
+            m = Needle()
+            m.parse_header(head[:NEEDLE_HEADER_SIZE])
+            if m.size != read_size:
+                return None  # buffered path raises the proper mismatch
+            data_len = struct.unpack(">I", head[NEEDLE_HEADER_SIZE:])[0]
+            if data_len < max(1, min_size):
+                return None
+            # tail = flags byte + optional name/mime/last_modified/ttl/pairs
+            tail_len = read_size - 4 - data_len
+            if tail_len < 1:
+                return None
+            tail = self.data_backend.read_at(
+                nv.offset + NEEDLE_HEADER_SIZE + 4 + data_len, tail_len
+            )
+            if len(tail) < tail_len:
+                return None
+            # dup under the lock: a concurrent vacuum commit swaps
+            # data_backend, and (nv.offset, fd) must come from the same
+            # backend generation
+            fd = os.dup(backend_fileno())
+        try:
+            # _read_body_v2 over a synthesized empty-data body parses the
+            # flags/name/mime/last_modified/ttl/pairs tail with the exact
+            # buffered-path logic
+            m._read_body_v2(struct.pack(">I", 0) + tail)
+        except Exception:
+            os.close(fd)
+            return None
+        m.size = read_size
+        n.__dict__.update(m.__dict__)
+        n.data = b""
+        from .needle import FLAG_HAS_LAST_MODIFIED, FLAG_HAS_TTL
+
+        if (
+            n.has(FLAG_HAS_TTL)
+            and n.ttl.minutes() != 0
+            and n.has(FLAG_HAS_LAST_MODIFIED)
+            and time.time() >= n.last_modified + n.ttl.minutes() * 60
+        ):
+            os.close(fd)
+            raise NotFoundError(f"needle {n.id:x} expired")
+        f = os.fdopen(fd, "rb", buffering=0)
+        return f, nv.offset + NEEDLE_HEADER_SIZE + 4, data_len
 
     # -- sequential scan (for rebuild/vacuum/export) -------------------------
     def scan_needles(

@@ -1,0 +1,252 @@
+"""Awaitable pipeline primitives for the event-loop serving core.
+
+``util/pipeline.py`` gave the gateway tier bounded read-ahead
+(``prefetch_iter``) and overlapped writes (``BoundedExecutor``) — but both
+are thread-shaped: every unit of overlap costs a worker thread, which is
+exactly the currency the asyncio reactor (``server/aio.py``) exists to
+stop spending. This module re-expresses the same three contracts as
+awaitables so the pipelined data plane can run on the loop:
+
+- ``aprefetch_iter``     — ordered read-ahead over an (a)iterable with at
+  most ``window`` fetches in flight, single-flight dedup by key, strict
+  input-order yields, and close-without-wait. Mirrors ``prefetch_iter``.
+- ``AioBoundedExecutor`` — in-flight-window task runner: ``submit``
+  awaits a slot, ``drain`` settles everything and returns results in
+  submit order (or raises the first error after full settle), ``abort``
+  settles and swallows. Mirrors ``BoundedExecutor``.
+- ``ThreadFlume``        — the thread→loop bounded byte channel the
+  reactor's response path rides: handler code (running in a worker
+  thread, byte-for-byte the threads-mode code) writes; the loop drains
+  to the socket. The window bounds resident bytes, so a slow client
+  backpressures the producing thread instead of buffering the body.
+
+All three bound memory to window × item size by construction, same as
+their thread-shaped ancestors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from collections import deque
+from typing import AsyncIterable, Callable, Iterable, Optional, Union
+
+
+async def _aiter(items: Union[Iterable, AsyncIterable]):
+    """Uniform async view over a sync or async iterable."""
+    if hasattr(items, "__aiter__"):
+        async for item in items:
+            yield item
+    else:
+        for item in items:
+            yield item
+
+
+async def aprefetch_iter(
+    items: Union[Iterable, AsyncIterable],
+    fetch: Callable,
+    window: int,
+    key: Optional[Callable] = None,
+):
+    """Async generator of ``(item, await fetch(item))`` pairs in input
+    order with at most ``window`` fetches in flight — the awaitable
+    mirror of ``util.pipeline.prefetch_iter`` (same ordering,
+    single-flight-by-key, eager-first-error, and close semantics; see
+    that docstring for the contract prose).
+
+    ``fetch`` is a coroutine function. Closing the generator cancels
+    fetches that nothing else references; ``window <= 1`` degenerates to
+    the serial awaited map.
+    """
+    if window <= 1:
+        async for item in _aiter(items):
+            yield item, await fetch(item)
+        return
+    key = key or (lambda item: item)
+    it = _aiter(items)
+    pending: deque = deque()  # (item, k, task) in input order
+    by_key: dict = {}  # key → [task, refcount] for single-flight dedup
+    try:
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < window:
+                try:
+                    item = await it.__anext__()
+                except StopAsyncIteration:
+                    exhausted = True
+                    break
+                k = key(item)
+                ent = by_key.get(k)
+                if ent is None:
+                    ent = by_key[k] = [
+                        asyncio.ensure_future(fetch(item)), 0
+                    ]
+                ent[1] += 1
+                pending.append((item, k, ent[0]))
+            if not pending:
+                return
+            item, k, task = pending.popleft()
+            try:
+                result = await asyncio.shield(task)
+            finally:
+                ent = by_key[k]
+                ent[1] -= 1
+                if ent[1] == 0:
+                    del by_key[k]
+            yield item, result
+    finally:
+        # close-without-wait: cancel every fetch no consumer will see;
+        # shield above keeps a shared (deduped) task alive for the
+        # earlier position still holding a reference
+        for _item, _k, task in pending:
+            task.cancel()
+            # retrieve the (cancelled or failed) result so the loop does
+            # not log "exception was never retrieved" for abandoned work
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception()
+            )
+
+
+class AioBoundedExecutor:
+    """In-flight-window coroutine runner — the awaitable mirror of
+    ``util.pipeline.BoundedExecutor`` (overlapped chunked writes:
+    ``submit`` self-throttles the producer at ``window`` in-flight tasks,
+    ``drain``/``abort`` settle every task before returning so error-path
+    cleanup sees the complete side-effect set)."""
+
+    def __init__(self, window: int):
+        self.window = max(1, window)
+        self._slots = asyncio.Semaphore(self.window)
+        self._tasks: list = []
+        self._first_error: Optional[BaseException] = None
+
+    async def submit(self, fn: Callable, *args, **kwargs) -> None:
+        if self._first_error is not None:
+            # surface the task failure at the producer promptly (stop
+            # consuming input); drain/abort still settles the window
+            raise self._first_error
+        await self._slots.acquire()
+
+        async def run():
+            try:
+                return await fn(*args, **kwargs)
+            except BaseException as e:
+                if self._first_error is None:
+                    self._first_error = e
+                raise
+            finally:
+                self._slots.release()
+
+        self._tasks.append(asyncio.ensure_future(run()))
+
+    async def drain(self) -> list:
+        """Settle every task; return results in submit order or raise
+        the first failure (after all have settled)."""
+        err: Optional[BaseException] = None
+        results = []
+        for task in self._tasks:
+            try:
+                results.append(await task)
+            except BaseException as e:
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+        return results
+
+    async def abort(self) -> None:
+        """Error-path settle: wait out every in-flight task, swallow
+        their errors — the original failure is what the caller reports."""
+        for task in self._tasks:
+            try:
+                await task
+            except BaseException:  # sweedlint: ok broad-except error-path settle; the caller re-raises the original failure
+                pass
+
+
+class ThreadFlumeClosed(Exception):
+    """The loop side tore the channel down (peer gone / server stopping);
+    producer writes raise this so handler threads stop generating."""
+
+
+class ThreadFlume:
+    """Bounded thread→loop byte channel.
+
+    The reactor runs handler code in worker threads (so the threads-mode
+    bytes-on-wire logic is reused verbatim) but owns the socket on the
+    loop. The flume is the seam: the worker calls ``put`` (blocking once
+    ``window`` chunks are queued — backpressure reaches the producing
+    thread, which is what bounds a fast handler against a slow client),
+    and the loop consumes via ``async for`` or ``get``.
+
+    ``close_read`` poisons the channel from the loop side: queued chunks
+    are dropped and producers unblock into ``ThreadFlumeClosed``.
+    """
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, window: int = 8):
+        self._loop = loop
+        self._window = max(1, window)
+        self._mu = threading.Lock()
+        self._chunks: deque = deque()
+        self._space = threading.Semaphore(self._window)
+        self._closed = False  # producer finished
+        self._broken = False  # consumer gone
+        self._waiter: Optional[asyncio.Future] = None  # loop-side wakeup
+
+    # -- thread side --------------------------------------------------------
+    def put(self, data: bytes, timeout: Optional[float] = None) -> None:
+        if not self._space.acquire(timeout=timeout):
+            raise TimeoutError("flume backpressure timeout")
+        with self._mu:
+            if self._broken:
+                self._space.release()
+                raise ThreadFlumeClosed()
+            self._chunks.append(data)
+            self._wake_locked()
+
+    def close(self) -> None:
+        """Producer is done; the loop side drains what is queued then
+        sees end-of-stream."""
+        with self._mu:
+            self._closed = True
+            self._wake_locked()
+
+    def _wake_locked(self) -> None:
+        w, self._waiter = self._waiter, None
+        if w is not None:
+            self._loop.call_soon_threadsafe(
+                lambda: w.done() or w.set_result(None)
+            )
+
+    # -- loop side ----------------------------------------------------------
+    async def get(self) -> Optional[bytes]:
+        """Next chunk, or None at end-of-stream."""
+        while True:
+            with self._mu:
+                if self._chunks:
+                    data = self._chunks.popleft()
+                    self._space.release()
+                    return data
+                if self._closed or self._broken:
+                    return None
+                waiter = self._waiter = self._loop.create_future()
+            await waiter
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> bytes:
+        data = await self.get()
+        if data is None:
+            raise StopAsyncIteration
+        return data
+
+    def close_read(self) -> None:
+        """Consumer gone: drop queued chunks and poison future puts."""
+        with self._mu:
+            self._broken = True
+            n = len(self._chunks)
+            self._chunks.clear()
+            self._wake_locked()
+        for _ in range(n):
+            self._space.release()

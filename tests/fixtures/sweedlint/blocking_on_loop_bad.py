@@ -1,0 +1,9 @@
+"""Fixture: time.sleep inside an async def — blocking-on-loop must fire
+exactly once, at the sleep call (stalls the event loop for every
+connection the reactor serves)."""
+import time
+
+
+async def handle(request):
+    time.sleep(0.01)
+    return request

@@ -1,0 +1,12 @@
+"""Fixture: same sleep-on-loop as blocking_on_loop_bad.py, waived with a
+reason — sweedlint must report nothing.  The awaited asyncio.sleep shows
+the exemption: awaited calls never count as blocking."""
+import asyncio
+import time
+
+
+async def handle(request):
+    await asyncio.sleep(0)
+    # sweedlint: ok blocking-on-loop fixture: startup-only path, loop carries no traffic yet
+    time.sleep(0.01)
+    return request

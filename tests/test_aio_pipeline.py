@@ -1,0 +1,398 @@
+"""Unit tests for util/aio_pipeline.py — the awaitable mirrors of the
+bounded-concurrency primitives (util/pipeline.py) that the asyncio
+serving core rides.
+
+No pytest-asyncio in the image: each test drives its coroutine through a
+plain ``asyncio.run``.  Fetches gate on asyncio.Event (loop-side tests)
+or threading.Event (ThreadFlume tests) so ordering, dedup, backpressure,
+and teardown are observed deterministically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.util.aio_pipeline import (
+    AioBoundedExecutor,
+    ThreadFlume,
+    ThreadFlumeClosed,
+    aprefetch_iter,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# --------------------------------------------------------------- aprefetch
+
+
+def test_aprefetch_yields_in_input_order():
+    async def main():
+        async def fetch(i):
+            return i * i
+
+        out = []
+        async for pair in aprefetch_iter(range(20), fetch, window=4):
+            out.append(pair)
+        return out
+
+    assert run(main()) == [(i, i * i) for i in range(20)]
+
+
+def test_aprefetch_window_one_is_serial():
+    calls = []
+
+    async def main():
+        async def fetch(i):
+            calls.append(i)
+            return i
+
+        gen = aprefetch_iter([1, 2, 3], fetch, window=1)
+        assert await gen.__anext__() == (1, 1)
+        # serial path: nothing is fetched ahead of the consumer
+        assert calls == [1]
+        rest = [pair async for pair in gen]
+        assert rest == [(2, 2), (3, 3)]
+
+    run(main())
+    assert calls == [1, 2, 3]
+
+
+def test_aprefetch_accepts_async_iterable():
+    async def main():
+        async def items():
+            for i in range(6):
+                yield i
+
+        async def fetch(i):
+            return -i
+
+        return [pair async for pair in aprefetch_iter(items(), fetch, 3)]
+
+    assert run(main()) == [(i, -i) for i in range(6)]
+
+
+def test_aprefetch_order_survives_slow_fetch():
+    """A slow fetch for item k must not let k+1 overtake it."""
+
+    async def main():
+        async def fetch(i):
+            if i == 0:
+                await asyncio.sleep(0.05)
+            return i
+
+        return [i async for i, _ in aprefetch_iter(range(6), fetch, 4)]
+
+    assert run(main()) == list(range(6))
+
+
+def test_aprefetch_single_flight_dedup():
+    """Interleaved views over the same key share one in-flight fetch."""
+    counts: dict = {}
+
+    async def main():
+        async def fetch(item):
+            k = item[0]
+            counts[k] = counts.get(k, 0) + 1
+            await asyncio.sleep(0.01)
+            return k.upper()
+
+        items = [("a", 1), ("b", 2), ("a", 3), ("b", 4)]
+        out = [
+            pair
+            async for pair in aprefetch_iter(
+                items, fetch, window=4, key=lambda t: t[0]
+            )
+        ]
+        assert out == [(i, i[0].upper()) for i in items]
+
+    run(main())
+    assert counts == {"a": 1, "b": 1}
+
+
+def test_aprefetch_error_propagates_at_position():
+    async def main():
+        async def fetch(i):
+            if i == 2:
+                raise ValueError("boom")
+            return i
+
+        gen = aprefetch_iter(range(5), fetch, window=4)
+        assert await gen.__anext__() == (0, 0)
+        assert await gen.__anext__() == (1, 1)
+        with pytest.raises(ValueError, match="boom"):
+            await gen.__anext__()
+
+    run(main())
+
+
+def test_aprefetch_first_item_error_is_eager():
+    async def main():
+        async def fetch(i):
+            raise OSError("no volume")
+
+        gen = aprefetch_iter([1, 2, 3], fetch, window=8)
+        with pytest.raises(OSError, match="no volume"):
+            await gen.__anext__()
+
+    run(main())
+
+
+def test_aprefetch_close_cancels_inflight():
+    """Closing the generator mid-stream (client disconnect) must return
+    promptly and cancel abandoned fetches instead of awaiting them."""
+    cancelled = []
+
+    async def main():
+        release = asyncio.Event()
+
+        async def fetch(i):
+            if i > 0:
+                try:
+                    await release.wait()
+                except asyncio.CancelledError:
+                    cancelled.append(i)
+                    raise
+            return i
+
+        gen = aprefetch_iter(range(8), fetch, window=4)
+        assert await gen.__anext__() == (0, 0)
+        t0 = time.monotonic()
+        await gen.aclose()  # wedged fetches still in flight
+        assert time.monotonic() - t0 < 1.0
+        await asyncio.sleep(0)  # let cancellations land
+
+    run(main())
+    assert cancelled, "abandoned in-flight fetches must be cancelled"
+
+
+def test_aprefetch_bounds_inflight_fetches():
+    """No more than `window` fetches are started ahead of the consumer."""
+    started = []
+
+    async def main():
+        gate = asyncio.Event()
+
+        async def fetch(i):
+            started.append(i)
+            await gate.wait()
+            return i
+
+        gen = aprefetch_iter(range(50), fetch, window=3)
+        task = asyncio.ensure_future(gen.__anext__())
+        await asyncio.sleep(0.05)  # give the window time to overfill
+        assert len(started) <= 3, started
+        gate.set()
+        assert await task == (0, 0)
+        rest = [i async for i, _ in gen]
+        assert rest == list(range(1, 50))
+
+    run(main())
+
+
+# ------------------------------------------------------ AioBoundedExecutor
+
+
+def test_aio_executor_drain_returns_submit_order():
+    async def main():
+        pipe = AioBoundedExecutor(window=4)
+
+        async def work(i):
+            if i % 2 == 0:
+                await asyncio.sleep(0.02)
+            return i * 10
+
+        for i in range(8):
+            await pipe.submit(work, i)
+        return await pipe.drain()
+
+    assert run(main()) == [i * 10 for i in range(8)]
+
+
+def test_aio_executor_submit_blocks_at_window():
+    """The producer self-throttles: submit #window+1 waits for a slot."""
+
+    async def main():
+        gate = asyncio.Event()
+        pipe = AioBoundedExecutor(window=2)
+        await pipe.submit(gate.wait)
+        await pipe.submit(gate.wait)
+        third = asyncio.ensure_future(pipe.submit(gate.wait))
+        await asyncio.sleep(0.05)
+        assert not third.done(), "third submit should park at window=2"
+        gate.set()
+        await third
+        await pipe.drain()
+
+    run(main())
+
+
+def test_aio_executor_failfast_submit_after_error():
+    async def main():
+        pipe = AioBoundedExecutor(window=2)
+
+        async def bad():
+            raise RuntimeError("upload failed")
+
+        await pipe.submit(bad)
+        await asyncio.sleep(0.01)  # let the failure land
+        with pytest.raises(RuntimeError, match="upload failed"):
+            await pipe.submit(asyncio.sleep, 0)
+        await pipe.abort()
+
+    run(main())
+
+
+def test_aio_executor_drain_raises_after_all_settle():
+    done = []
+
+    async def main():
+        all_submitted = asyncio.Event()
+
+        async def work(i):
+            await all_submitted.wait()
+            if i == 1:
+                raise ValueError("chunk 1 died")
+            await asyncio.sleep(0.02)
+            done.append(i)
+            return i
+
+        pipe = AioBoundedExecutor(window=4)
+        for i in range(4):
+            await pipe.submit(work, i)
+        all_submitted.set()
+        with pytest.raises(ValueError, match="chunk 1 died"):
+            await pipe.drain()
+
+    run(main())
+    assert sorted(done) == [0, 2, 3]
+
+
+def test_aio_executor_abort_settles_and_swallows():
+    done = []
+
+    async def main():
+        pipe = AioBoundedExecutor(window=3)
+
+        async def ok(i):
+            done.append(i)
+
+        async def bad():
+            raise RuntimeError("x")
+
+        await pipe.submit(ok, 1)
+        await pipe.submit(bad)
+        await pipe.submit(ok, 2)
+        await pipe.abort()  # must not raise
+
+    run(main())
+    assert sorted(done) == [1, 2]
+
+
+def test_aio_executor_window_floor_is_one():
+    async def main():
+        pipe = AioBoundedExecutor(window=0)
+        assert pipe.window == 1
+
+        async def seven():
+            return 7
+
+        await pipe.submit(seven)
+        return await pipe.drain()
+
+    assert run(main()) == [7]
+
+
+# -------------------------------------------------------------- ThreadFlume
+
+
+def test_flume_bytes_arrive_in_order():
+    async def main():
+        loop = asyncio.get_running_loop()
+        flume = ThreadFlume(loop, window=4)
+
+        def producer():
+            for i in range(16):
+                flume.put(bytes([i]) * 3)
+            flume.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        chunks = [c async for c in flume]
+        t.join(5)
+        return chunks
+
+    assert run(main()) == [bytes([i]) * 3 for i in range(16)]
+
+
+def test_flume_backpressures_producer_at_window():
+    """put() blocks once `window` chunks are queued — a slow consumer
+    stalls the producing thread instead of buffering the body."""
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        flume = ThreadFlume(loop, window=2)
+        progress = []
+
+        def producer():
+            for i in range(5):
+                flume.put(b"x")
+                progress.append(i)
+            flume.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        await asyncio.sleep(0.1)
+        assert len(progress) <= 2, progress
+        drained = [c async for c in flume]
+        t.join(5)
+        assert len(drained) == 5
+
+    run(main())
+
+
+def test_flume_close_read_poisons_producer():
+    """Consumer teardown (peer gone) unblocks a parked producer into
+    ThreadFlumeClosed so handler threads stop generating the body."""
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        flume = ThreadFlume(loop, window=1)
+        outcome = []
+
+        def producer():
+            try:
+                while True:
+                    flume.put(b"y", timeout=5)
+            except ThreadFlumeClosed:
+                outcome.append("closed")
+            except TimeoutError:
+                outcome.append("timeout")
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        await asyncio.sleep(0.05)  # producer fills the window and parks
+        flume.close_read()
+        t.join(5)
+        assert outcome == ["closed"]
+        assert await flume.get() is None
+
+    run(main())
+
+
+def test_flume_get_returns_none_at_eos():
+    async def main():
+        loop = asyncio.get_running_loop()
+        flume = ThreadFlume(loop, window=2)
+        flume.put(b"a")
+        flume.close()
+        assert await flume.get() == b"a"
+        assert await flume.get() is None
+        assert await flume.get() is None  # EOS is sticky
+
+    run(main())

@@ -1,0 +1,654 @@
+"""Serving-core tests: shutdown race, admission control, aio/threads
+byte parity, the zero-copy sendfile read path, and coalesced assigns.
+
+Raw sockets throughout — admission rejections and keep-alive shedding
+happen below urllib's abstraction level, and byte parity between the two
+serving cores is only meaningful on the wire.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import ssl
+import subprocess
+import threading
+import time
+import types
+
+import pytest
+
+from seaweedfs_tpu.server.http_util import (
+    JsonHandler,
+    StreamBody,
+    _TrackingThreadingHTTPServer,
+    start_server,
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _App(JsonHandler):
+    """Minimal route table exercising every reply shape the cores share."""
+
+    gate = threading.Event()  # /slow parks here
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+def _routes():
+    def ping(h, path, q, body):
+        return 200, {"ok": True, "q": q.get("x", "")}
+
+    def blob(h, path, q, body):
+        return 200, b"\x00\x01binary\xff" * 40
+
+    def echo(h, path, q, body):
+        return 200, body
+
+    def stream(h, path, q, body):
+        pieces = [b"abc" * 10, b"defgh" * 6, b"z" * 7]
+        return 200, StreamBody(sum(len(p) for p in pieces), iter(pieces))
+
+    def slow(h, path, q, body):
+        _App.gate.wait(10)
+        return 200, {"slept": True}
+
+    def boom(h, path, q, body):
+        raise RuntimeError("handler exploded")
+
+    return [
+        ("GET", "/ping", ping),
+        ("GET", "/blob", blob),
+        ("HEAD", "/blob", blob),
+        ("POST", "/echo", echo),
+        ("GET", "/stream", stream),
+        ("GET", "/slow", slow),
+        ("GET", "/boom", boom),
+    ]
+
+
+_App.routes = _routes()
+
+
+def _recv_response(sock, head_only=False):
+    """One HTTP response off a raw socket → (status, headers, body)."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        got = sock.recv(65536)
+        if not got:
+            raise ConnectionError(f"EOF in headers: {buf!r}")
+        buf += got
+    head, body = buf.split(b"\r\n\r\n", 1)
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    # a HEAD reply advertises Content-Length but carries no body
+    want = 0 if head_only else int(headers.get("content-length", "0"))
+    while len(body) < want:
+        got = sock.recv(65536)
+        if not got:
+            break
+        body += got
+    return status, headers, body
+
+
+def _request(sock, method, path, body=b"", extra=""):
+    req = (
+        f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+        f"Content-Length: {len(body)}\r\n{extra}\r\n"
+    ).encode() + body
+    sock.sendall(req)
+    return _recv_response(sock, head_only=(method == "HEAD"))
+
+
+@pytest.fixture
+def serving_env(monkeypatch):
+    """Baseline knobs: high watermark, no leftovers from other tests."""
+    monkeypatch.setenv("SWEED_MAX_INFLIGHT", "8192")
+    monkeypatch.delenv("SWEED_SERVING", raising=False)
+    return monkeypatch
+
+
+def _start_app(mode):
+    os.environ["SWEED_SERVING"] = mode
+    try:
+        return start_server(_App, "127.0.0.1", free_port())
+    finally:
+        os.environ.pop("SWEED_SERVING", None)
+
+
+# ------------------------------------------------------- shutdown race
+
+
+def test_shutdown_then_late_accept_closes_not_registers(serving_env):
+    """The PR 7 race: a connection the accept loop dequeued BEFORE
+    shutdown() flipped the flag must be closed by process_request, not
+    registered as an untracked ghost that outlives the server."""
+    srv = _start_app("threads")
+    try:
+        srv.shutdown()
+        a, b = socket.socketpair()
+        try:
+            srv.process_request(a, ("127.0.0.1", 0))
+            # the raced socket was severed, nothing was registered
+            assert a.fileno() == -1
+            assert srv.inflight_count() == 0
+            b.settimeout(2)
+            assert b.recv(1) == b""  # peer sees EOF, not a ghost server
+        finally:
+            b.close()
+    finally:
+        srv.server_close()
+
+
+def test_shutdown_severs_established_keepalive(serving_env):
+    srv = _start_app("threads")
+    host, port = srv.server_address[:2]
+    c = socket.create_connection((host, port), timeout=5)
+    try:
+        st, _, _ = _request(c, "GET", "/ping")
+        assert st == 200
+        srv.shutdown()
+        c.settimeout(5)
+        assert c.recv(1) == b""  # severed, not parked on a ghost
+    finally:
+        c.close()
+        srv.server_close()
+
+
+@pytest.fixture(scope="module")
+def self_signed(tmp_path_factory):
+    d = tmp_path_factory.mktemp("snake")
+    key, crt = str(d / "s.key"), str(d / "s.crt")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "2",
+         "-subj", "/CN=127.0.0.1"],
+        check=True, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(crt, key)
+    return ctx
+
+
+def test_tls_shutdown_race_kills_swapped_socket(serving_env, self_signed):
+    """TLS variant of the race: the handshake completes in the worker
+    AFTER shutdown()'s sever pass ran, so the swapped-in TLS socket must
+    die in finish_request instead of becoming the ghost."""
+    os.environ["SWEED_SERVING"] = "threads"
+    try:
+        srv = start_server(
+            _App, "127.0.0.1", free_port(), ssl_context=self_signed
+        )
+    finally:
+        os.environ.pop("SWEED_SERVING", None)
+    try:
+        srv.shutdown()
+        a, b = socket.socketpair()
+        cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        cctx.check_hostname = False
+        cctx.verify_mode = ssl.CERT_NONE
+        state = {}
+
+        def client():
+            try:
+                b.settimeout(10)
+                tls = cctx.wrap_socket(b)  # handshake with finish_request
+                state["eof"] = tls.recv(1) == b""
+                tls.close()
+            except (ssl.SSLError, OSError) as e:
+                state["err"] = e
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        srv.finish_request(a, ("127.0.0.1", 0))
+        t.join(10)
+        assert srv.inflight_count() == 0
+        # the client either saw clean EOF post-handshake or a torn
+        # handshake — both mean no ghost server answered
+        assert state.get("eof") or "err" in state, state
+    finally:
+        srv.server_close()
+
+
+# ---------------------------------------------------- admission control
+
+
+@pytest.mark.parametrize("mode", ["threads", "aio"])
+def test_admission_watermark_503_and_recovery(serving_env, mode):
+    serving_env.setenv("SWEED_MAX_INFLIGHT", "2")
+    serving_env.setenv("SWEED_RETRY_AFTER", "7")
+    _App.gate = threading.Event()
+    srv = _start_app(mode)
+    host, port = srv.server_address[:2]
+    conns = []
+    try:
+        # fill the watermark with two parked requests
+        for _ in range(2):
+            c = socket.create_connection((host, port), timeout=10)
+            c.sendall(b"GET /slow HTTP/1.1\r\nHost: t\r\n"
+                      b"Content-Length: 0\r\n\r\n")
+            conns.append(c)
+        deadline = time.monotonic() + 5
+        while srv.inflight_count() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.inflight_count() >= 2
+
+        # connection #3 is shed with the canned 503
+        c3 = socket.create_connection((host, port), timeout=10)
+        st, hdrs, body = _recv_response(c3)
+        assert st == 503
+        assert hdrs["retry-after"] == "7"
+        assert hdrs["connection"] == "close"
+        assert body == b""
+        c3.settimeout(5)
+        assert c3.recv(1) == b""
+        c3.close()
+
+        # in-flight requests complete untruncated; whichever replies
+        # while still at the watermark is told to drop its keep-alive
+        # slot (the first shed deregisters it, so the later reply may
+        # legitimately see a drained server and keep its connection)
+        _App.gate.set()
+        shed = []
+        for c in conns:
+            st, hdrs, body = _recv_response(c)
+            assert st == 200
+            assert b'"slept": true' in body
+            shed.append(hdrs.get("connection") == "close")
+        assert any(shed), "a reply at the watermark must shed keep-alive"
+
+        # recovery: below the watermark again, a fresh client is served
+        for c in conns:
+            c.close()
+        conns.clear()
+        deadline = time.monotonic() + 5
+        while srv.inflight_count() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        c4 = socket.create_connection((host, port), timeout=10)
+        st, hdrs, _ = _request(c4, "GET", "/ping")
+        assert st == 200
+        assert hdrs.get("connection") != "close"
+        c4.close()
+    finally:
+        for c in conns:
+            c.close()
+        _App.gate.set()
+        srv.server_close()
+
+
+def test_serving_status_counters_move(serving_env):
+    from seaweedfs_tpu.stats import serving_stats
+
+    serving_env.setenv("SWEED_MAX_INFLIGHT", "1")
+    _App.gate = threading.Event()
+    srv = _start_app("threads")
+    host, port = srv.server_address[:2]
+    before = serving_stats()
+    c1 = socket.create_connection((host, port), timeout=10)
+    try:
+        c1.sendall(b"GET /slow HTTP/1.1\r\nHost: t\r\n"
+                   b"Content-Length: 0\r\n\r\n")
+        deadline = time.monotonic() + 5
+        while srv.inflight_count() < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        c2 = socket.create_connection((host, port), timeout=10)
+        st, _, _ = _recv_response(c2)
+        assert st == 503
+        c2.close()
+        _App.gate.set()
+        _recv_response(c1)
+        after = serving_stats()
+        assert after["admission_rejected"] > before["admission_rejected"]
+        assert after["keepalive_shed"] > before["keepalive_shed"]
+        assert set(after) >= {
+            "mode", "watermark", "inflight", "loop_lag_ms",
+            "assign_batches", "assign_avg_batch",
+        }
+    finally:
+        _App.gate.set()
+        c1.close()
+        srv.server_close()
+
+
+# ------------------------------------------------- aio/threads parity
+
+
+def _collect_wire(mode):
+    _App.gate.set()
+    srv = _start_app(mode)
+    host, port = srv.server_address[:2]
+    out = []
+    try:
+        c = socket.create_connection((host, port), timeout=10)
+        try:
+            for method, path, body in [
+                ("GET", "/ping?x=1", b""),
+                ("GET", "/blob", b""),
+                ("HEAD", "/blob", b""),
+                ("POST", "/echo", b"payload \x00bytes" * 9),
+                ("GET", "/stream", b""),
+                ("GET", "/nope", b""),
+                ("GET", "/boom", b""),
+                ("GET", "/ping", b""),  # keep-alive survived all of it
+            ]:
+                st, hdrs, rbody = _request(c, method, path, body)
+                hdrs.pop("date", None)  # only legitimately varying header
+                out.append((method, path, st, sorted(hdrs.items()), rbody))
+        finally:
+            c.close()
+    finally:
+        srv.server_close()
+    return out
+
+
+def test_aio_threads_wire_parity(serving_env):
+    """The reactor runs the handler class unmodified, so every reply —
+    JSON, raw bytes, streamed, 404, handler-crash 500 — must be
+    byte-identical to threads mode (Date aside)."""
+    assert _collect_wire("threads") == _collect_wire("aio")
+
+
+# ------------------------------------------------------- sendfile path
+
+
+@pytest.fixture(scope="module")
+def vol_cluster(tmp_path_factory):
+    """master + volume with the turbo engine off so GETs run the Python
+    handler (where the sendfile path lives)."""
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    old = os.environ.get("SWEED_TURBO")
+    os.environ["SWEED_TURBO"] = "0"
+    tmp = tmp_path_factory.mktemp("sendfile")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp / "v")], port=free_port(), master_url=master.url,
+        max_volume_count=5, pulse_seconds=0.5,
+    ).start()
+    deadline = time.monotonic() + 10
+    from seaweedfs_tpu import operation
+    while time.monotonic() < deadline:
+        try:
+            operation.assign(master.url)
+            break
+        except Exception:
+            time.sleep(0.1)
+    yield master, volume
+    volume.stop()
+    master.stop()
+    if old is None:
+        os.environ.pop("SWEED_TURBO", None)
+    else:
+        os.environ["SWEED_TURBO"] = old
+
+
+def _spy_sendfile(volume):
+    calls = []
+    real = volume._sendfile_reply
+
+    def spy(h, q, n, ext):
+        r = real(h, q, n, ext)
+        if r is not None:
+            calls.append(n.id)
+        return r
+
+    volume._sendfile_reply = spy
+    return calls
+
+
+def _get(url):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def test_sendfile_get_bytes_identical_to_buffered(vol_cluster, monkeypatch):
+    from seaweedfs_tpu import operation
+
+    master, volume = vol_cluster
+    data = os.urandom(100_000)
+    a = operation.assign(master.url)
+    operation.upload_data(a.url, a.fid, data, compress=False)
+    calls = _spy_sendfile(volume)
+    try:
+        st, hdrs, body = _get(f"http://{a.url}/{a.fid}")
+        assert (st, body) == (200, data)
+        assert hdrs["Content-Length"] == str(len(data))
+        assert calls, "100KB body above the floor must take sendfile"
+        zero_copy = (st, body)
+        monkeypatch.setenv("SWEED_SENDFILE", "0")
+        calls.clear()
+        assert _get(f"http://{a.url}/{a.fid}")[::2] == zero_copy
+        assert not calls, "SWEED_SENDFILE=0 must disable the path"
+    finally:
+        volume._sendfile_reply = volume._sendfile_reply  # spy stays harmless
+
+
+def test_sendfile_range_reads(vol_cluster):
+    import urllib.request
+
+    from seaweedfs_tpu import operation
+
+    master, volume = vol_cluster
+    data = os.urandom(200_000)
+    a = operation.assign(master.url)
+    operation.upload_data(a.url, a.fid, data, compress=False)
+    calls = _spy_sendfile(volume)
+    req = urllib.request.Request(
+        f"http://{a.url}/{a.fid}", headers={"Range": "bytes=1000-60999"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 206
+        assert r.headers["Content-Range"] == f"bytes 1000-60999/{len(data)}"
+        assert r.read() == data[1000:61000]
+    assert calls, "range over a large needle must take sendfile"
+
+
+def test_sendfile_floor_keeps_small_needles_buffered(vol_cluster):
+    from seaweedfs_tpu import operation
+
+    master, volume = vol_cluster
+    data = os.urandom(1000)  # below SWEED_SENDFILE_MIN
+    a = operation.assign(master.url)
+    operation.upload_data(a.url, a.fid, data, compress=False)
+    calls = _spy_sendfile(volume)
+    st, _, body = _get(f"http://{a.url}/{a.fid}")
+    assert (st, body) == (200, data)
+    assert not calls, "small needles stay on the buffered path"
+
+
+def test_volume_read_needle_extent_contract(tmp_path):
+    """Storage-layer contract: the extent points at exactly the data
+    bytes, the synthesized-tail parse recovers the metadata, and the
+    paths that cannot be zero-copied answer None (not garbage)."""
+    from seaweedfs_tpu.storage.needle import (
+        FLAG_HAS_MIME,
+        FLAG_HAS_NAME,
+        Needle,
+    )
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 1)
+    data = os.urandom(80_000)
+    n = Needle(id=0x42, cookie=0x1234, data=data)
+    n.name = b"hello.bin"
+    n.mime = b"application/x-test"
+    n.set_flag(FLAG_HAS_NAME)
+    n.set_flag(FLAG_HAS_MIME)
+    v.write_needle(n)
+
+    probe = Needle(id=0x42)
+    ext = v.read_needle_extent(probe, min_size=1)
+    assert ext is not None
+    f, off, count = ext
+    assert count == len(data)
+    f.seek(off)
+    assert f.read(count) == data
+    f.close()
+    assert probe.name == b"hello.bin"
+    assert probe.mime == b"application/x-test"
+    assert probe.data == b""
+
+    # below the floor → buffered path
+    assert v.read_needle_extent(Needle(id=0x42), min_size=1 << 20) is None
+    v.close()
+
+
+# ------------------------------------------------ bench probe smoke
+
+
+@pytest.mark.parametrize("mode", ["threads", "aio"])
+def test_bench_probe_serving_smoke(mode):
+    """Fast end-to-end run of bench.py --probe-serving: tiny connection
+    count, real multi-process cluster, both serving modes. Guards the
+    probe's plumbing (spawn/wait/sweep/JSON shape) and the zero-failure,
+    byte-verified contract at smoke scale."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--probe-serving", mode, "8", "200"],
+        capture_output=True, text=True, timeout=180, cwd=repo, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["mode"] == mode
+    (row,) = out["sweep"]
+    assert row["conns"] == 8
+    for phase in ("sat", "paced"):
+        st = row[phase]
+        assert st["n"] == 200, st
+        assert st["failed"] == 0, st
+        assert st["mismatched"] == 0, st
+        assert st["rps"] > 0 and st["p50_ms"] > 0 and st["p99_ms"] > 0
+
+
+# ----------------------------------------------------- assign coalescer
+
+
+class _StubMaster:
+    def __init__(self):
+        self.calls = []
+        self.hold = threading.Event()
+        self.hold.set()
+        self.fail = False
+        self._n = 0
+        self._mu = threading.Lock()
+
+    def assign(self, master, count=1, **kw):
+        from seaweedfs_tpu.operation import Assignment
+
+        self.hold.wait(10)
+        with self._mu:
+            self.calls.append(count)
+            self._n += 1
+            n = self._n
+        if self.fail:
+            raise RuntimeError("master down")
+        return Assignment(
+            fid=f"3,{n:08x}00000000", url="127.0.0.1:0",
+            public_url="127.0.0.1:0", count=count,
+        )
+
+
+@pytest.fixture
+def coalescer(monkeypatch):
+    from seaweedfs_tpu.server import filer_server
+
+    stub = _StubMaster()
+    monkeypatch.setattr(filer_server.operation, "assign", stub.assign)
+    fs = types.SimpleNamespace(master_url="127.0.0.1:0", jwt_signing_key="")
+    return filer_server._AssignCoalescer(fs), stub
+
+
+def test_coalescer_batches_concurrent_assigns(coalescer):
+    co, stub = coalescer
+    stub.hold.clear()  # park the leader's RPC so the others queue behind it
+    results, errors = [], []
+    mu = threading.Lock()
+
+    def worker():
+        try:
+            a = co.assign("", "", "")
+            with mu:
+                results.append(a.fid)
+        except Exception as e:
+            with mu:
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True) for _ in range(40)]
+    threads[0].start()
+    deadline = time.monotonic() + 5
+    while not stub.calls and time.monotonic() < deadline:
+        time.sleep(0.005)  # leader reached the (held) RPC
+    for t in threads[1:]:
+        t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with co._lock:
+            queued = sum(len(q) for q in co._queues.values())
+        if queued >= 39:
+            break
+        time.sleep(0.005)
+    stub.hold.set()
+    for t in threads:
+        t.join(10)
+
+    assert not errors, errors
+    assert len(set(results)) == 40, "every caller needs a distinct fid"
+    assert len(stub.calls) == 2, f"40 callers must coalesce: {stub.calls}"
+    assert sorted(stub.calls) == [1, 39]
+
+
+def test_coalescer_uncontended_is_single_direct_rpc(coalescer):
+    co, stub = coalescer
+    a = co.assign("c", "010", "")
+    assert a.fid and stub.calls == [1]
+
+
+def test_coalescer_error_reaches_every_waiter_then_recovers(coalescer):
+    co, stub = coalescer
+    stub.fail = True
+    stub.hold.clear()
+    errors = []
+    mu = threading.Lock()
+
+    def worker():
+        try:
+            co.assign("", "", "")
+        except RuntimeError as e:
+            with mu:
+                errors.append(str(e))
+
+    threads = [threading.Thread(target=worker, daemon=True) for _ in range(8)]
+    threads[0].start()
+    time.sleep(0.05)
+    for t in threads[1:]:
+        t.start()
+    time.sleep(0.05)
+    stub.hold.set()
+    for t in threads:
+        t.join(10)
+    assert len(errors) == 8
+    assert all("master down" in e for e in errors)
+
+    stub.fail = False
+    assert co.assign("", "", "").fid  # the group state fully reset

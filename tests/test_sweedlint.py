@@ -35,6 +35,7 @@ CASES = [
     # interprocedural rules (analysis/lockgraph.py, analysis/taint.py)
     ("lock-order", "lock_order", "cluster/fixture.py"),
     ("blocking-under-lock", "blocking_under_lock", "storage/fixture.py"),
+    ("blocking-on-loop", "blocking_on_loop", "server/fixture.py"),
     ("tainted-size", "tainted_size", "server/fixture.py"),
 ]
 
