@@ -722,6 +722,331 @@ def probe_serving(mode: str, conns_csv: str, total: int) -> None:
     print(json.dumps(out))
 
 
+def probe_hotshard(n_needles: int, n_requests: int) -> None:
+    """Child mode: the hot-shard story end to end — zipfian (s≈1.1) GET
+    storm against a prepopulated 2-node cluster, measured cold/random,
+    after ``volume.balance -heat``, and after enabling the hot-needle RAM
+    cache.  Every response body is byte-verified.
+
+    Setup: ``n_needles`` needles are written directly into 8 volumes —
+    the newest (hottest, the classic Haystack age skew) half of the
+    corpus interleaves across volumes 5-8 and the cold half across 1-4.
+    Volumes 1-4 start on node A and 5-8 on node B, so the zipf head
+    concentrates on B but spans four volumes there: heat rebalance can
+    genuinely split it (volume granularity could not split a single
+    dominating volume — that case is the cache tier's job).  The
+    volume servers run the aio core with the mmap needle-map kind and a
+    modeled per-disk-read service delay (faultpoint, like the filer-pipe
+    probe); a RAM cache hit skips the modeled seek exactly as it skips
+    the real one.  Each GET storm is preceded by a small PUT storm
+    through master ``/dir/assign`` so heat-weighted placement is on the
+    measured path (the assign spread per node is reported).
+
+    Phases: (A) baseline storm, cache off, heat accumulating;
+    (B) ``volume.balance -heat -force`` moves hot replicas off node B via
+    the existing copy path, then the same storm again; (C) cache enabled
+    live via POST /admin/ncache on both servers, warmup pass, then the
+    same storm.  Prints one JSON line with p50/p99 per phase, the
+    balance plan, cache hit ratio, and the headline
+    ``p99_improvement = baseline_p99 / after_cache_p99``."""
+    import asyncio
+    import socket
+    import tempfile
+
+    import numpy as np
+
+    VOLS = 8
+    PAYLOAD = 256
+    READ_DELAY_S = 0.002  # modeled HDD seek per needle read (the Haystack
+    # premise: one seek per read), serialized per node like one spindle —
+    # load concentration queues, and RAM cache hits skip the line entirely
+    ZIPF_S = 1.1
+    CACHE_BYTES = 64 << 20
+    conns = max(8, min(64, n_requests // 16))
+
+    def payload_of(i: int) -> bytes:
+        return (i.to_bytes(8, "big") * ((PAYLOAD + 7) // 8))[:PAYLOAD]
+
+    def cookie_of(i: int) -> int:
+        return (i * 0x9E3779B1 + 0x5EED) & 0xFFFFFFFF
+
+    def vol_of(i: int) -> int:
+        # newest half (the zipf head under rank = n-1-i) spreads over
+        # volumes 5-8, oldest half over 1-4
+        base = VOLS // 2 + 1 if i >= n_needles // 2 else 1
+        return base + i % (VOLS // 2)
+
+    def fid_of(i: int) -> str:
+        from seaweedfs_tpu.storage.file_id import FileId
+
+        return str(FileId(vol_of(i), i + 1, cookie_of(i)))
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def wait_port(port, timeout=30.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                return
+            except OSError:
+                time.sleep(0.1)
+        raise RuntimeError(f"server on :{port} never came up")
+
+    def spawn(code, extra_env=None):
+        env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
+        return subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+
+    from seaweedfs_tpu.server.http_util import http_bytes, http_json
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+    from seaweedfs_tpu.storage.volume import Volume
+
+    mp = free_port()
+    vports = [free_port(), free_port()]
+    procs = []
+    serve_env = {
+        "SWEED_SERVING": "aio",
+        "SWEED_TURBO": "0",  # heat accounting + faultpoints live in Python
+        "SWEED_FAULTPOINTS": (
+            f"volume.read.needle=serial-delay:{READ_DELAY_S}::0,"
+            f"volume.write.needle=delay:{READ_DELAY_S}::0"
+        ),
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        # -- prepopulate: needles in index order; the newest (hottest)
+        # half interleaves across vids 5-8 (node B), the cold half
+        # across 1-4 (node A)
+        dirs = [os.path.join(tmp, "v0"), os.path.join(tmp, "v1")]
+        for d in dirs:
+            os.makedirs(d)
+        rp = ReplicaPlacement.from_string("000")
+        vols = {
+            vid: Volume(dirs[0] if vid <= VOLS // 2 else dirs[1], "", vid, rp)
+            for vid in range(1, VOLS + 1)
+        }
+        for i in range(n_needles):
+            vols[vol_of(i)].write_needle(
+                Needle(cookie=cookie_of(i), id=i + 1, data=payload_of(i))
+            )
+        for v in vols.values():
+            v.close()
+
+        # -- zipf request schedule, shared by every phase (same offered
+        # load, so the phases differ only in placement + cache)
+        ranks = np.arange(1, n_needles + 1, dtype=np.float64)
+        w = ranks ** -ZIPF_S
+        rng = np.random.default_rng(7)
+        sample = rng.choice(n_needles, size=n_requests, p=w / w.sum())
+        idxs = (n_needles - 1 - sample).tolist()
+
+        try:
+            procs.append(spawn(
+                "import time\n"
+                "from seaweedfs_tpu.server.master_server import MasterServer\n"
+                f"MasterServer(host='127.0.0.1', port={mp}).start()\n"
+                "time.sleep(3600)\n",
+                extra_env=serve_env,
+            ))
+            wait_port(mp)
+            for d, vp in zip(dirs, vports):
+                procs.append(spawn(
+                    "import time\n"
+                    "from seaweedfs_tpu.server.volume_server import VolumeServer\n"
+                    f"VolumeServer([{d!r}], host='127.0.0.1', port={vp}, "
+                    f"master_url='127.0.0.1:{mp}', max_volume_count=20, "
+                    "pulse_seconds=0.5, needle_map_kind='mmap').start()\n"
+                    "time.sleep(3600)\n",
+                    extra_env=serve_env,
+                ))
+            for vp in vports:
+                wait_port(vp)
+
+            def locations() -> dict[int, str]:
+                out = {}
+                for vid in range(1, VOLS + 1):
+                    r = http_json(
+                        "GET",
+                        f"http://127.0.0.1:{mp}/dir/lookup?volumeId={vid}",
+                    )
+                    locs = r.get("locations") or []
+                    if locs:
+                        out[vid] = locs[0]["url"]
+                return out
+
+            deadline = time.perf_counter() + 30
+            vidurl = locations()
+            while len(vidurl) < VOLS and time.perf_counter() < deadline:
+                time.sleep(0.3)
+                vidurl = locations()
+            if len(vidurl) < VOLS:
+                raise RuntimeError(f"only {len(vidurl)}/{VOLS} volumes registered")
+
+            def put_storm(n_puts: int) -> dict:
+                """Assign + upload through the master's heat-weighted pick;
+                returns the per-node assign spread."""
+                spread: dict[str, int] = {}
+                blob = os.urandom(PAYLOAD)
+                for _ in range(n_puts):
+                    a = http_json("GET", f"http://127.0.0.1:{mp}/dir/assign")
+                    url = a["url"]
+                    spread[url] = spread.get(url, 0) + 1
+                    st, _ = http_bytes(
+                        "POST", f"http://{url}/{a['fid']}", blob
+                    )
+                    if st != 201:
+                        raise RuntimeError(f"PUT {a['fid']}: HTTP {st}")
+                return spread
+
+            async def storm(vid2url: dict[int, str]) -> dict:
+                counters = {"failed": 0, "mismatched": 0}
+                latencies: list[float] = []
+                per = [
+                    n_requests // conns + (1 if k < n_requests % conns else 0)
+                    for k in range(conns)
+                ]
+
+                async def worker(wid: int, count: int):
+                    mine = idxs[wid::conns][:count]
+                    pool: dict[str, tuple] = {}
+                    try:
+                        for i in mine:
+                            url = vid2url[vol_of(i)]
+                            rw = pool.get(url)
+                            if rw is None:
+                                hostp, portp = url.split(":")
+                                rw = await asyncio.open_connection(
+                                    hostp, int(portp)
+                                )
+                                pool[url] = rw
+                            reader, writer = rw
+                            req = (
+                                f"GET /{fid_of(i)} HTTP/1.1\r\nHost: b\r\n"
+                                "Content-Length: 0\r\n\r\n"
+                            ).encode()
+                            t0 = time.perf_counter()
+                            try:
+                                writer.write(req)
+                                await writer.drain()
+                                head = await asyncio.wait_for(
+                                    reader.readuntil(b"\r\n\r\n"), 60
+                                )
+                                status = int(head.split(b" ", 2)[1])
+                                clen = 0
+                                for ln in head.split(b"\r\n"):
+                                    if ln.lower().startswith(b"content-length:"):
+                                        clen = int(ln.split(b":")[1])
+                                body = await asyncio.wait_for(
+                                    reader.readexactly(clen), 60
+                                )
+                            except (OSError, asyncio.TimeoutError,
+                                    asyncio.IncompleteReadError,
+                                    asyncio.LimitOverrunError):
+                                counters["failed"] += 1
+                                pool.pop(url, None)
+                                continue
+                            latencies.append(time.perf_counter() - t0)
+                            if status != 200 or body != payload_of(i):
+                                counters["mismatched"] += 1
+                    finally:
+                        for _, wtr in pool.values():
+                            wtr.close()
+
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(worker(k, per[k]) for k in range(conns) if per[k])
+                )
+                wall = time.perf_counter() - t0
+                lat = sorted(latencies)
+                ok = len(lat)
+                return {
+                    "n": ok,
+                    "rps": round(ok / wall, 1) if wall > 0 else 0.0,
+                    "p50_ms": round(lat[ok // 2] * 1e3, 2) if ok else None,
+                    "p99_ms": round(
+                        lat[max(0, int(ok * 0.99) - 1)] * 1e3, 2
+                    ) if ok else None,
+                    "failed": counters["failed"],
+                    "mismatched": counters["mismatched"],
+                }
+
+            n_puts = max(10, n_requests // 20)
+            out = {
+                "needles": n_needles,
+                "requests": n_requests,
+                "zipf_s": ZIPF_S,
+                "conns": conns,
+                "modeled_read_ms": READ_DELAY_S * 1e3,
+                "needle_map_kind": "mmap",
+            }
+
+            # -- phase A: cold/random baseline (heat accumulates here) ----
+            out["assign_spread_baseline"] = put_storm(n_puts)
+            out["baseline"] = asyncio.run(storm(vidurl))
+
+            # -- phase B: heat-aware rebalance through the shell ----------
+            from seaweedfs_tpu.shell import commands as C
+
+            env = C.CommandEnv(f"127.0.0.1:{mp}")
+            bal = C.volume_balance(env, apply=True, heat=True)
+            out["balance_moved"] = bal["moved"]
+            deadline = time.perf_counter() + 30
+            vidurl = locations()
+            while len(vidurl) < VOLS and time.perf_counter() < deadline:
+                time.sleep(0.3)
+                vidurl = locations()
+            out["assign_spread_balanced"] = put_storm(n_puts)
+            out["after_balance"] = asyncio.run(storm(vidurl))
+
+            # -- phase C: hot-needle RAM cache on, warm, re-measure -------
+            for vp in vports:
+                http_json(
+                    "POST",
+                    f"http://127.0.0.1:{vp}/admin/ncache?capacity={CACHE_BYTES}",
+                )
+            asyncio.run(storm(vidurl))  # warmup: populates the cache
+            out["after_cache"] = asyncio.run(storm(vidurl))
+            ncache = {"hits": 0, "misses": 0}
+            for vp in vports:
+                s = http_json("GET", f"http://127.0.0.1:{vp}/status")
+                ncache["hits"] += s["ncache"]["hits"]
+                ncache["misses"] += s["ncache"]["misses"]
+            lookups = ncache["hits"] + ncache["misses"]
+            out["cache_hit_ratio"] = (
+                round(ncache["hits"] / lookups, 4) if lookups else 0.0
+            )
+            base_p99 = out["baseline"]["p99_ms"]
+            after_p99 = out["after_cache"]["p99_ms"]
+            out["p99_improvement"] = (
+                round(base_p99 / after_p99, 2)
+                if base_p99 and after_p99 else None
+            )
+            out["mismatched"] = sum(
+                out[ph]["mismatched"]
+                for ph in ("baseline", "after_balance", "after_cache")
+            )
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    print(json.dumps(out))
+
+
 class _NullSink:
     """File-like that discards writes: isolates read+H2D+compute+D2H from
     any filesystem at all (the 'where is the first real bottleneck' probe)."""
@@ -1234,6 +1559,26 @@ def main() -> None:
                 f"{serving['aio_vs_threads']['aio_paced_p99_vs_low_conns']}x "
                 f"its c={lo} paced p99")
 
+    # -- hot-shard path: zipfian storm vs heat rebalance + needle cache -------
+    hotshard = None
+    try:
+        r = _run_probe(["--probe-hotshard", "2000000", "40000"], timeout=600)
+        if r.returncode == 0 and r.stdout.strip():
+            hotshard = json.loads(r.stdout.strip().splitlines()[-1])
+            log(
+                f"hotshard: baseline p99={hotshard['baseline']['p99_ms']}ms "
+                f"→ balanced p99={hotshard['after_balance']['p99_ms']}ms "
+                f"→ cached p99={hotshard['after_cache']['p99_ms']}ms "
+                f"({hotshard['p99_improvement']}x, hit ratio "
+                f"{hotshard['cache_hit_ratio']}, "
+                f"mismatched={hotshard['mismatched']})"
+            )
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            log(f"hotshard probe failed: {tail[0][:140]}")
+    except subprocess.TimeoutExpired:
+        log("hotshard probe timed out")
+
     # -- encode probes in fresh subprocesses ----------------------------------
     best, best_cfg, best_raw = 0.0, None, 0.0
     successes = 0
@@ -1443,6 +1788,7 @@ def main() -> None:
                 "smallfile": smallfile,
                 "filer_pipe": filer_pipe,
                 "serving": serving,
+                "hotshard": hotshard,
                 "e2e": e2e,
                 "e2e_note": (
                     "all sinks tunnel-bound on this dev host (~100 MB/s "
@@ -1486,6 +1832,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 4 and sys.argv[1] == "--probe-serving":
         probe_serving(sys.argv[2], sys.argv[3],
                       int(sys.argv[4]) if len(sys.argv) > 4 else 20000)
+    elif sys.argv[1:2] == ["--probe-hotshard"]:
+        probe_hotshard(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 2_000_000,
+            int(sys.argv[3]) if len(sys.argv) > 3 else 40_000,
+        )
     elif len(sys.argv) >= 3 and sys.argv[1] == "--probe-e2e":
         probe_e2e(int(sys.argv[2]),
                   sys.argv[3] if len(sys.argv) > 3 else "disk")

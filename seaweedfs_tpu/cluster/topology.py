@@ -33,6 +33,10 @@ class VolumeInfo:
     version: int = 3
     ttl: TTL = field(default_factory=TTL)
     compact_revision: int = 0
+    # decayed op counters from the volume server's EWMA heat accounting
+    # (stats/heat.py); old servers simply never report them
+    read_heat: float = 0.0
+    write_heat: float = 0.0
 
     @classmethod
     def from_heartbeat(cls, m: dict) -> "VolumeInfo":
@@ -52,6 +56,8 @@ class VolumeInfo:
             version=m.get("version", 3),
             ttl=load_ttl_from_uint32(m.get("ttl", 0)),
             compact_revision=m.get("compact_revision", 0),
+            read_heat=m.get("read_heat", 0.0),
+            write_heat=m.get("write_heat", 0.0),
         )
 
 

@@ -1,8 +1,13 @@
 """VolumeLayout: writable/readonly volume sets per (collection, rp, ttl).
 
-Mirrors `weed/topology/volume_layout.go`: tracks vid → replica locations,
-keeps the writable list consistent with replica counts and sizes, and picks
-random writable volumes for assignment.
+Mirrors `weed/topology/volume_layout.go`: tracks vid → replica locations and
+keeps the writable list consistent with replica counts and sizes.  Where the
+reference picks writables uniformly at random, this layout weights the pick
+by free space over volume heat (the EWMA counters volume servers ship in
+heartbeats — stats/heat.py) and skips volumes whose every replica sits on an
+overloaded node, so zipfian read storms stop attracting new writes to the
+nodes already melting (the f4 observation).  The divergence is recorded in
+docs/PARITY.md.
 """
 
 from __future__ import annotations
@@ -17,6 +22,19 @@ from ..util.locks import make_rlock
 
 if TYPE_CHECKING:
     from .topology import DataNode, VolumeInfo
+
+# module-level RNG so placement is seedable in tests (seed_placement) and
+# no pick path reaches for the process-global `random` state
+_rng = random.Random()
+
+# a node is overloaded when its heat exceeds this multiple of the mean
+# node heat among the current candidates' replica holders
+OVERLOAD_FACTOR = 2.0
+
+
+def seed_placement(seed=None) -> None:
+    """Seed the placement RNG — deterministic writable picks for tests."""
+    _rng.seed(seed)
 
 
 class VolumeLayout:
@@ -33,6 +51,9 @@ class VolumeLayout:
         self.writables: list[int] = []
         self.readonly_volumes: set[int] = set()
         self.oversized_volumes: set[int] = set()
+        # vid → read+write heat, refreshed from every heartbeat's
+        # VolumeInfo; feeds the weighted pick below
+        self.volume_heat: dict[int, float] = {}
         self._lock = make_rlock("VolumeLayout._lock")
 
     # -- registration (volume_layout.go:104-200) -----------------------------
@@ -50,12 +71,14 @@ class VolumeLayout:
                 locs.remove(dn)
             if not locs:
                 self.vid2location.pop(vi.id, None)
+                self.volume_heat.pop(vi.id, None)
                 self._remove_from_writable(vi.id)
             else:
                 self._ensure_writable_state(vi.id)
 
     def ensure_correct_writables(self, vi: "VolumeInfo") -> None:
         with self._lock:
+            self.volume_heat[vi.id] = vi.read_heat + vi.write_heat
             if vi.read_only:
                 self.readonly_volumes.add(vi.id)
             else:
@@ -94,6 +117,7 @@ class VolumeLayout:
                 locs.remove(dn)
             if not locs:
                 self.vid2location.pop(vid, None)
+                self.volume_heat.pop(vid, None)
             self._ensure_writable_state(vid)
             return vid in self.writables
 
@@ -102,27 +126,75 @@ class VolumeLayout:
             self.readonly_volumes.add(vid)
             self._remove_from_writable(vid)
 
-    # -- assignment (volume_layout.go:267-300) -------------------------------
+    # -- assignment (volume_layout.go:267-300, heat-weighted divergence) -----
     def pick_for_write(
         self, data_center: str = ""
     ) -> tuple[int, list["DataNode"]]:
         with self._lock:
             if not self.writables:
                 raise NoWritableVolumesError("no more writable volumes")
-            if not data_center:
-                vid = random.choice(self.writables)
-                return vid, list(self.vid2location[vid])
             candidates = []
             for vid in self.writables:
                 locs = self.vid2location.get(vid, [])
-                if any(dn.get_data_center().id == data_center for dn in locs):
-                    candidates.append((vid, locs))
+                if data_center and not any(
+                    dn.get_data_center().id == data_center for dn in locs
+                ):
+                    continue
+                candidates.append((vid, locs))
             if not candidates:
                 raise NoWritableVolumesError(
                     f"no writable volumes in data center {data_center}"
                 )
-            vid, locs = random.choice(candidates)
+            candidates = self._drop_overloaded(candidates)
+            vid, locs = self._weighted_pick(candidates)
             return vid, list(locs)
+
+    def _drop_overloaded(self, candidates):
+        """Skip volumes whose every replica sits on an overloaded node
+        (node heat > OVERLOAD_FACTOR × mean over candidate holders).
+        Falls back to the full list when the filter would empty it —
+        degraded placement still beats NoWritableVolumesError."""
+        node_heat: dict["DataNode", float] = {}
+        for vid, locs in candidates:
+            h = self.volume_heat.get(vid, 0.0)
+            for dn in locs:
+                node_heat[dn] = node_heat.get(dn, 0.0) + h
+        if len(node_heat) < 2:
+            return candidates
+        mean = sum(node_heat.values()) / len(node_heat)
+        if mean <= 0.0:
+            return candidates
+        overloaded = {
+            dn for dn, h in node_heat.items() if h > OVERLOAD_FACTOR * mean
+        }
+        if not overloaded:
+            return candidates
+        kept = [
+            (vid, locs)
+            for vid, locs in candidates
+            if locs and not all(dn in overloaded for dn in locs)
+        ]
+        return kept or candidates
+
+    def _weighted_pick(self, candidates):
+        """Sample one candidate ∝ free-space / (1 + heat): cold volumes on
+        roomy nodes absorb new writes, hot ones cool off.  With no heat
+        and uniform free space this degrades to the reference's uniform
+        random pick."""
+        weights = []
+        for vid, locs in candidates:
+            free = min((dn.free_space() for dn in locs), default=0)
+            heat = self.volume_heat.get(vid, 0.0)
+            weights.append((1.0 + max(0, free)) / (1.0 + heat))
+        total = sum(weights)
+        if total <= 0.0:
+            return candidates[_rng.randrange(len(candidates))]
+        r = _rng.random() * total
+        for pair, w in zip(candidates, weights):
+            r -= w
+            if r <= 0.0:
+                return pair
+        return candidates[-1]
 
     def active_volume_count(self) -> int:
         return len(self.writables)
@@ -136,6 +208,11 @@ class VolumeLayout:
                 "readonly": sorted(self.readonly_volumes),
                 "oversized": sorted(self.oversized_volumes),
                 "volume_count": len(self.vid2location),
+                "heat": {
+                    str(vid): round(h, 3)
+                    for vid, h in sorted(self.volume_heat.items())
+                    if h > 0.0
+                },
             }
 
 

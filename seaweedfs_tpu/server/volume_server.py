@@ -118,10 +118,18 @@ class VolumeServer:
             needle_map_kind=needle_map_kind,
         )
         self.store.remote_shard_reader = self._remote_shard_reader
+        # hot-needle RAM cache tier (util/needle_cache.py): byte budget
+        # from SWEED_NCACHE (0 = off), resizable live via POST /admin/ncache
+        from ..util.needle_cache import NeedleCache
+
+        self.ncache = NeedleCache(
+            tolerant_uint(os.environ.get("SWEED_NCACHE"), 0) or 0
+        )
         self._srv = None
         self.turbo = None
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        self._scrub_thread: Optional[threading.Thread] = None
 
     # -- remote EC shard read via master shard lookup ------------------------
     def _remote_shard_reader(self, vid, shard_id, offset, size):
@@ -184,11 +192,31 @@ class VolumeServer:
         if not self._auth_ok(h, path, q, self.jwt_read_key):
             return 401, {"error": "unauthorized read"}
         self._req_count.inc(op="get")
-        # chaos/bench hook: delay here models cross-machine RTT + disk seek
-        # per needle read (the wait the filer's read-ahead window hides)
-        faultpoints.fire("volume.read.needle")
         with self._req_hist.time(op="get"):
             vid, nid, cookie = self._parse_fid_path(path)
+            wants_resize = bool(
+                tolerant_uint(q.get("width"), None)
+                or tolerant_uint(q.get("height"), None)
+            )
+            if self.ncache.enabled and not wants_resize:
+                cached = self.ncache.get(vid, nid, cookie)
+                if cached is not None:
+                    # hot-needle RAM hit: exactly the bytes a disk read of
+                    # this plain needle would return (mutations invalidate,
+                    # cookies are checked by the cache); the heat signal
+                    # must still see the read or the cache would mask the
+                    # skew placement reacts to
+                    self.store.note_volume_read(vid)
+                    rng = h.headers.get("Range", "")
+                    if rng:
+                        return self._range_reply(h, cached, rng)
+                    h.extra_headers = {"Accept-Ranges": "bytes"}
+                    return 200, cached
+            # chaos/bench hook: delay here models cross-machine RTT + disk
+            # seek per needle read (the wait the filer's read-ahead window
+            # hides); fired below the cache check — a RAM hit skips the
+            # modeled disk seek, exactly as it skips the real one
+            faultpoints.fire("volume.read.needle")
             n = Needle(id=nid)
             ext = None
             try:
@@ -203,6 +231,24 @@ class VolumeServer:
                 if ext is not None:
                     ext[0].close()
                 return 404, {"error": "cookie mismatch"}
+            if ext is not None:
+                if (
+                    self.ncache.would_cache(ext[2])
+                    and not wants_resize
+                    and not n.is_chunk_manifest
+                    and not n.is_compressed
+                ):
+                    # hot-tier populate on miss: one buffered read of the
+                    # extent now buys RAM hits after; oversized extents
+                    # never reach here (would_cache), so bulk traffic
+                    # keeps the pure zero-copy path
+                    f, data_off, data_len = ext
+                    try:
+                        n.data = os.pread(f.fileno(), data_len, data_off)
+                    finally:
+                        f.close()
+                    self.ncache.put(vid, nid, cookie, bytes(n.data))
+                    ext = None
             if ext is not None:
                 resp = self._sendfile_reply(h, q, n, ext)
                 if resp is not None:
@@ -227,6 +273,14 @@ class VolumeServer:
                 return tolerant_uint(q.get(key), None) or None
 
             width, height = _dim("width"), _dim("height")
+            if (
+                self.ncache.would_cache(len(data))
+                and not n.is_compressed
+                and not (width or height)
+            ):
+                # buffered-path populate: plain needles only, so a later
+                # hit can be served verbatim with no metadata decisions
+                self.ncache.put(vid, nid, cookie, data)
             serving_gzip = False
             if n.is_compressed:
                 # serve gzip verbatim only to clients that asked for it;
@@ -478,6 +532,9 @@ class VolumeServer:
         _, size, unchanged = self.store.write_volume_needle(
             vid, n, fsync=q.get("fsync") == "true"
         )
+        # overwrite makes any cached copy stale (replica deletes on failed
+        # fan-out pass through here too, so the entry never outlives the data)
+        self.ncache.invalidate(vid, nid)
         if q.get("type") != "replicate":
             err = self._replicate(path, q, body, h, "POST")
             if err:
@@ -523,6 +580,7 @@ class VolumeServer:
                     glog.warning("manifest parse vid %d: %s", vid, e)
         n = Needle(cookie=cookie, id=nid)
         size = self.store.delete_volume_needle(vid, n)
+        self.ncache.invalidate(vid, nid)
         if q.get("type") != "replicate":
             err = self._replicate(path, q, b"", h, "DELETE")
             if err:
@@ -1172,9 +1230,100 @@ class VolumeServer:
         return 200, out.encode()
 
     def _h_status(self, h, path, q, body):
+        from ..stats import heat_stats, scrub_stats
+
         hb = self.store.collect_heartbeat()
         hb["ec"] = self.store.collect_ec_heartbeat()["ec_shards"]
+        hb["heat"] = heat_stats()
+        hb["ncache"] = self.ncache.stats()
+        hb["scrub"] = scrub_stats()
         return 200, hb
+
+    def _h_ncache(self, h, path, q, body):
+        """Resize the hot-needle cache byte budget at runtime
+        (?capacity=<bytes>, 0 disables).  Lets an operator — and the
+        hot-shard probe — toggle the tier without restarting the server."""
+        cap = q.get("capacity")
+        if cap is None and body:
+            cap = json.loads(body).get("capacity")
+        if cap is not None:
+            self.ncache.set_capacity(_q_req_uint({"capacity": cap}, "capacity"))
+        return 200, self.ncache.stats()
+
+    # -- background CRC scrub (SWEED_SCRUB=1) --------------------------------
+    def _scrub_loop(self):
+        """Continuously re-read needle records and verify stored CRCs, at
+        most SWEED_SCRUB_RATE needles per second per volume (default 32).
+
+        The sendfile read path ships payload bytes straight out of the
+        page cache without CRC verification (PARITY row 74); this scrub
+        is its safety net — silent on-disk corruption surfaces as
+        sweed_scrub_crc_errors_total instead of never."""
+        rate = max(1, tolerant_uint(os.environ.get("SWEED_SCRUB_RATE"), 32))
+        cursors: dict[int, int] = {}  # vid → next .dat offset to verify
+        while not self._stop.is_set():
+            vols = [
+                v
+                for loc in self.store.locations
+                for v in list(loc.volumes.values())
+            ]
+            for v in vols:
+                if self._stop.is_set():
+                    return
+                try:
+                    cursors[v.id] = self._scrub_volume_step(
+                        v, cursors.get(v.id, 0), rate
+                    )
+                except Exception as e:  # noqa: BLE001
+                    # compaction/unmount shifted the ground under the
+                    # cursor; restart this volume from the front
+                    glog.warning("scrub vid %d reset: %s", v.id, e)
+                    cursors[v.id] = 0
+            self._stop.wait(1.0)
+
+    @staticmethod
+    def _scrub_volume_step(v, offset: int, budget: int) -> int:
+        """Verify up to ``budget`` live needles of one volume starting at
+        ``offset``; returns the cursor for the next step (0 = wrapped)."""
+        from ..stats import SCRUB_COUNTERS
+        from ..storage.needle import (
+            CrcError,
+            needle_body_length,
+            parse_needle_header,
+        )
+        from ..storage.types import NEEDLE_HEADER_SIZE
+
+        size = v.data_backend.size()
+        offset = max(offset, v.super_block.block_size())
+        checked = 0
+        while checked < budget and offset + NEEDLE_HEADER_SIZE <= size:
+            hdr = v.data_backend.read_at(offset, NEEDLE_HEADER_SIZE)
+            if len(hdr) < NEEDLE_HEADER_SIZE:
+                break
+            _, nid, nsize = parse_needle_header(hdr)
+            body_len = needle_body_length(nsize if nsize > 0 else 0, v.version)
+            total = NEEDLE_HEADER_SIZE + body_len
+            if offset + total > size:
+                break
+            if nsize > 0:  # tombstones carry no payload to verify
+                blob = v.data_backend.read_at(offset, total)
+                try:
+                    Needle.from_bytes(blob, nsize, v.version, verify_crc=True)
+                except CrcError:
+                    SCRUB_COUNTERS["errors"].inc()
+                    glog.warning(
+                        "scrub: CRC mismatch vid %d needle %d @%d",
+                        v.id, nid, offset,
+                    )
+                SCRUB_COUNTERS["checked"].inc()
+                SCRUB_COUNTERS["bytes"].inc(total)
+                checked += 1
+            offset += total
+        if offset + NEEDLE_HEADER_SIZE > size:
+            if size > v.super_block.block_size():  # empty volumes don't count
+                SCRUB_COUNTERS["rounds"].inc()
+            return 0
+        return offset
 
     def _h_ui(self, h, path, q, body):
         """Embedded status page (server/volume_server_ui analog)."""
@@ -1315,6 +1464,7 @@ class VolumeServer:
                 ("GET", "/admin/needle_ids", vs._h_needle_ids),
                 ("GET", "/admin/needle_info", vs._h_needle_info),
                 ("POST", "/_query", vs._h_query),
+                ("POST", "/admin/ncache", vs._h_ncache),
                 ("GET", "/status", vs._h_status),
                 ("GET", "/ui", vs._h_ui),
                 ("GET", "/metrics", vs._h_metrics),
@@ -1378,11 +1528,19 @@ class VolumeServer:
             glog.warning("initial heartbeat to %s failed", self.master_url)
         self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
         self._hb_thread.start()
+        if os.environ.get("SWEED_SCRUB") == "1":
+            self._scrub_thread = threading.Thread(
+                target=self._scrub_loop, daemon=True
+            )
+            self._scrub_thread.start()
         return self
 
     def stop(self):
         self._stop.set()
         self.store.delta_event.set()  # wake the heartbeat loop to exit
+        if self._scrub_thread is not None:
+            self._scrub_thread.join(timeout=2.0)
+            self._scrub_thread = None
         # stop accepting on the PUBLIC port first (the native engine drains
         # in-flight proxies against the still-live backend), then the
         # loopback backend, then the store (volume detach is a no-op C call

@@ -611,12 +611,87 @@ def _balance_plan(
     return plan
 
 
+def _heat_balance_plan(volumes: list[dict], nodes: list[dict]) -> list[dict]:
+    """Move replicas off hot nodes.  Node heat = Σ (read+write) EWMA heat
+    of its replicas (the heartbeat fields from stats/heat.py); while the
+    hottest node carries more than 1.1× the mean, relocate its hottest
+    movable volume to the coldest node without a replica of it.  A
+    divergence from the reference (which balances counts only) — zipfian
+    storms need the hot head spread, not the volume census evened."""
+    urls = [n["url"] for n in nodes]
+    if len(urls) < 2:
+        return []
+    held: dict[str, set[int]] = {u: set() for u in urls}
+    movable: dict[str, list[dict]] = {u: [] for u in urls}
+    vheat: dict[tuple[str, int], float] = {}
+    for v in volumes:
+        u = v["server"]
+        if u not in held:
+            continue
+        held[u].add(v["id"])
+        movable[u].append(v)
+        vheat[(u, v["id"])] = v.get("read_heat", 0.0) + v.get("write_heat", 0.0)
+    heat = {u: sum(vheat.get((u, vid), 0.0) for vid in held[u]) for u in urls}
+    plan: list[dict] = []
+    for _ in range(100):  # hard stop, each iteration moves one volume
+        mean = sum(heat.values()) / len(heat)
+        src = max(heat, key=heat.get)
+        if mean <= 0.0 or heat[src] <= 1.1 * mean:
+            break  # within 10% of even — the ≥10%-cut rule below would
+            # reject every remaining move anyway, stop churning
+        moved = False
+        for cand in sorted(
+            movable[src],
+            key=lambda v: vheat.get((src, v["id"]), 0.0),
+            reverse=True,
+        ):
+            h = vheat.get((src, cand["id"]), 0.0)
+            if h <= 0.0:
+                break  # only cold volumes left on the hot node
+            dsts = sorted(
+                (u for u in urls if u != src and cand["id"] not in held[u]),
+                key=heat.get,
+            )
+            if not dsts:
+                continue
+            dst = dsts[0]
+            # accept only if the cluster's hottest node cools by ≥10% —
+            # forbids no-op swaps of a single dominating volume between
+            # nodes (volume granularity can't split one hot volume;
+            # that's the cache tier's job)
+            if max(heat[src] - h, heat[dst] + h) > 0.9 * heat[src]:
+                continue
+            plan.append(
+                {"vid": cand["id"], "from": src, "to": dst, "heat": round(h, 3)}
+            )
+            movable[src].remove(cand)
+            held[src].discard(cand["id"])
+            held[dst].add(cand["id"])
+            movable[dst].append(cand)
+            vheat[(dst, cand["id"])] = h
+            heat[src] -= h
+            heat[dst] += h
+            moved = True
+            break
+        if not moved:
+            break
+    return plan
+
+
 def volume_balance(
-    env: CommandEnv, collection: Optional[str] = None, apply: bool = True
+    env: CommandEnv,
+    collection: Optional[str] = None,
+    apply: bool = True,
+    heat: bool = False,
 ) -> dict:
     """Even out volume counts per server capacity
-    (command_volume_balance.go). apply=False returns the plan only."""
-    plan = _balance_plan(volume_list(env), env.data_nodes(), collection)
+    (command_volume_balance.go). apply=False returns the plan only.
+    heat=True balances EWMA heat instead of counts, moving replicas off
+    nodes melting under zipfian read storms."""
+    if heat:
+        plan = _heat_balance_plan(volume_list(env), env.data_nodes())
+    else:
+        plan = _balance_plan(volume_list(env), env.data_nodes(), collection)
     moved = []
     if apply:
         for m in plan:

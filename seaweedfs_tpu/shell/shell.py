@@ -22,7 +22,8 @@ HELP = """commands:
   volume.configure.replication -volumeId=N -replication=XYZ
   volume.tier.upload -volumeId=N [-backend=s3.default|-endpoint=..] [-bucket=B]
   volume.tier.download -volumeId=N
-  volume.balance [-collection=C] [-force=true]  plan (and apply) even spread
+  volume.balance [-collection=C] [-force=true] [-heat]  plan (and apply) even
+                 spread; -heat moves replicas off hot nodes (EWMA heat)
   volumeServer.evacuate -node=host:port         drain a server
   volumeServer.leave -node=host:port            deregister a server now
   volume.fsck [-apply=true]                     find orphan needles vs filer
@@ -117,9 +118,13 @@ def run_command(env: CommandEnv, line: str) -> object:
             flags.get("source", ""),
         )
     if cmd == "volume.balance":
-        # plan-only unless -force (command_volume_balance.go's opt-in)
+        # plan-only unless -force (command_volume_balance.go's opt-in);
+        # -heat balances EWMA heat instead of volume counts
         return C.volume_balance(
-            env, flags.get("collection"), apply=flags.get("force") == "true"
+            env,
+            flags.get("collection"),
+            apply=flags.get("force") == "true",
+            heat=flags.get("heat") == "true",
         )
     if cmd == "volumeServer.evacuate":
         return C.volume_server_evacuate(env, flags["node"])

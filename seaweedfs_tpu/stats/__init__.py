@@ -7,10 +7,13 @@ from .metrics import (  # noqa: F401
     Gauge,
     Histogram,
     QUERY_COUNTERS,
+    SCRUB_COUNTERS,
     Registry,
     default_registry,
     disk_status,
     memory_status,
     query_stats,
+    scrub_stats,
     serving_stats,
 )
+from .heat import EwmaHeat, heat_stats  # noqa: F401

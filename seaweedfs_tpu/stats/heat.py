@@ -1,0 +1,97 @@
+"""Per-volume read/write heat: exponentially-decayed op counters.
+
+Real object traffic is zipfian (the Haystack paper's founding observation;
+f4 built its warm tier on the same skew), so placement that ignores access
+frequency keeps stacking new writes onto already-hot spindles. Every
+volume carries two ``EwmaHeat`` counters (reads, writes) marked on the
+store's data-plane routing; the decayed values ride the heartbeat to the
+master (`storage/store.py` ``_volume_message``), where
+`cluster/volume_layout.py` folds them into writable picks and
+``volume.balance -heat`` uses them to move replicas off hot nodes.
+
+The native turbo data plane serves fid reads without entering Python, so
+heat is only accounted on the Python path — heat-aware deployments run
+``SWEED_TURBO=0`` (the probes already do).
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+
+from ..util.locks import make_lock
+
+# one half-life of inactivity halves a volume's heat: long enough that a
+# rebalance sees a stable ranking, short enough that yesterday's storm
+# doesn't pin today's placement
+HEAT_HALFLIFE_SECONDS = 60.0
+
+
+class EwmaHeat:
+    """Exponentially-decayed op counter.
+
+    ``value()`` is a decayed op count: an op marked now weighs 1, an op a
+    half-life ago weighs 0.5. Dividing by ``halflife / ln 2`` would give a
+    smoothed ops/sec rate; placement only needs relative weight, so the
+    raw decayed count is what the system calls "heat"."""
+
+    __slots__ = ("halflife", "_v", "_t", "_lock")
+
+    def __init__(self, halflife: float = HEAT_HALFLIFE_SECONDS):
+        self.halflife = halflife
+        self._v = 0.0
+        self._t = time.monotonic()
+        self._lock = make_lock("EwmaHeat._lock")
+
+    def _decay_locked(self) -> None:
+        now = time.monotonic()
+        dt = now - self._t
+        if dt > 0.0:
+            self._v *= 0.5 ** (dt / self.halflife)
+            self._t = now
+
+    def mark(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._decay_locked()
+            self._v += n
+
+    def value(self) -> float:
+        with self._lock:
+            self._decay_locked()
+            return self._v
+
+
+# live stores register here so the sweed_heat_* gauges and /_status can
+# aggregate without the stats package holding servers alive (the
+# _ServingState WeakSet precedent in server/http_util.py)
+_stores: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_store(store) -> None:
+    _stores.add(store)
+
+
+def heat_stats() -> dict:
+    """Aggregate heat across every live local store, for the gauges and
+    the volume server's /_status heat section."""
+    read = write = max_volume = 0.0
+    volumes = 0
+    for store in list(_stores):
+        try:
+            for loc in store.locations:
+                for v in list(loc.volumes.values()):
+                    r = v.read_heat.value()
+                    w = v.write_heat.value()
+                    read += r
+                    write += w
+                    volumes += 1
+                    if r + w > max_volume:
+                        max_volume = r + w
+        except Exception:  # sweedlint: ok broad-except a store mid-teardown must not break the gauge
+            pass
+    return {
+        "read_heat": round(read, 3),
+        "write_heat": round(write, 3),
+        "max_volume_heat": round(max_volume, 3),
+        "volumes": volumes,
+    }

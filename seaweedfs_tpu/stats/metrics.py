@@ -304,6 +304,108 @@ def query_stats() -> dict:
     }
 
 
+def register_heat_metrics(registry: Optional[Registry] = None) -> None:
+    """Gauges over the per-volume heat EWMAs (stats/heat.py), summed
+    across every live local store.  Zero when traffic has decayed away."""
+
+    def _snap(key):
+        from .heat import heat_stats
+
+        return heat_stats().get(key, 0)
+
+    reg = registry if registry is not None else default_registry
+    reg.gauge(
+        "sweed_heat_read",
+        "decayed read-op heat summed over local volumes",
+    ).set_function(lambda: _snap("read_heat"))
+    reg.gauge(
+        "sweed_heat_write",
+        "decayed write-op heat summed over local volumes",
+    ).set_function(lambda: _snap("write_heat"))
+    reg.gauge(
+        "sweed_heat_max_volume",
+        "hottest single local volume (read+write heat)",
+    ).set_function(lambda: _snap("max_volume_heat"))
+
+
+register_heat_metrics()
+
+
+def register_ncache_metrics(registry: Optional[Registry] = None) -> None:
+    """Gauges over the hot-needle RAM cache (util/needle_cache.py),
+    summed across live caches (one per volume server)."""
+
+    def _snap(key):
+        from ..util.needle_cache import ncache_stats
+
+        return ncache_stats().get(key, 0)
+
+    reg = registry if registry is not None else default_registry
+    reg.gauge(
+        "sweed_ncache_hits_total",
+        "volume GETs answered from the hot-needle RAM cache",
+    ).set_function(lambda: _snap("hits"))
+    reg.gauge(
+        "sweed_ncache_misses_total",
+        "cacheable volume GETs that fell through to disk",
+    ).set_function(lambda: _snap("misses"))
+    reg.gauge(
+        "sweed_ncache_evictions_total",
+        "entries evicted to hold the byte budget",
+    ).set_function(lambda: _snap("evictions"))
+    reg.gauge(
+        "sweed_ncache_bytes",
+        "payload bytes resident in the hot-needle cache",
+    ).set_function(lambda: _snap("bytes"))
+    reg.gauge(
+        "sweed_ncache_entries",
+        "needles resident in the hot-needle cache",
+    ).set_function(lambda: _snap("entries"))
+
+
+register_ncache_metrics()
+
+
+def register_scrub_metrics(
+    registry: Optional[Registry] = None,
+) -> dict[str, Counter]:
+    """Counters for the background CRC scrub (server/volume_server.py,
+    SWEED_SCRUB=1) — the safety net for the CRC-unverified sendfile path
+    (PARITY row 74)."""
+    reg = registry if registry is not None else default_registry
+    return {
+        "checked": reg.counter(
+            "sweed_scrub_needles_checked_total",
+            "needle CRCs verified by the background scrub",
+        ),
+        "bytes": reg.counter(
+            "sweed_scrub_bytes_total",
+            "needle payload bytes read back by the scrub",
+        ),
+        "errors": reg.counter(
+            "sweed_scrub_crc_errors_total",
+            "needles whose stored CRC did not match the payload",
+        ),
+        "rounds": reg.counter(
+            "sweed_scrub_rounds_total",
+            "full passes completed over a volume",
+        ),
+    }
+
+
+SCRUB_COUNTERS = register_scrub_metrics()
+
+
+def scrub_stats() -> dict:
+    """Snapshot of the scrub counters for /_status."""
+    return {
+        "needles_checked": SCRUB_COUNTERS["checked"].total(),
+        "bytes_read": SCRUB_COUNTERS["bytes"].total(),
+        "crc_errors": SCRUB_COUNTERS["errors"].total(),
+        "rounds": SCRUB_COUNTERS["rounds"].total(),
+    }
+
+
 # -- host probes (stats/disk.go, memory.go) ----------------------------------
 def disk_status(path: str) -> dict:
     st = os.statvfs(path)

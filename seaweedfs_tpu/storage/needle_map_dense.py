@@ -24,11 +24,18 @@ Kinds here (needle_map.go:12-19 analog):
 - ``SortedFileNeedleMap`` — the read-only kind
   (`needle_map_sorted_file.go:19`): binary-searches a key-sorted index file
   (.sdx) directly on disk, zero resident entries; for sealed volumes.
+- ``MmapNeedleMap`` — the billion-needle kind: the same key-sorted base
+  format memory-mapped read-only (np.memmap over `<volume>.mdx`), so
+  lookups fault in O(log n) pages and a 1e8–1e9-entry index stays
+  page-cache-resident with near-zero RSS; mutations shadow the base in an
+  overflow dict and batched merges atomically rewrite the mapped file.
 """
 
 from __future__ import annotations
 
 import io
+import json
+import mmap
 import os
 import threading
 from typing import BinaryIO, Callable, Iterator, Optional
@@ -51,13 +58,12 @@ from .types import (
 _KEY_BIAS = 1 << 63
 
 
-def _parse_idx_arrays(
-    raw: bytes, offset_size: int
+def _parse_entry_matrix(
+    a: np.ndarray, offset_size: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Vectorized .idx parse → (keys u64, scaled offsets u64, sizes i64)."""
-    entry = needle_map_entry_size(offset_size)
-    n = len(raw) // entry
-    a = np.frombuffer(raw[: n * entry], dtype=np.uint8).reshape(n, entry)
+    """(n, entry)-shaped uint8 rows → (keys u64, scaled offsets u64,
+    sizes i64); only the sliced columns are ever copied, so the input can
+    be a memmap without faulting the whole file in."""
     keys = a[:, :8].copy().view(">u8").ravel().astype(np.uint64)
     if offset_size == 4:
         offs = a[:, 8:12].copy().view(">u4").ravel().astype(np.uint64)
@@ -75,6 +81,48 @@ def _parse_idx_arrays(
         .astype(np.int64)
     )
     return keys, offs, sizes
+
+
+def _parse_idx_arrays(
+    raw: bytes, offset_size: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized .idx parse → (keys u64, scaled offsets u64, sizes i64)."""
+    entry = needle_map_entry_size(offset_size)
+    n = len(raw) // entry
+    a = np.frombuffer(raw[: n * entry], dtype=np.uint8).reshape(n, entry)
+    return _parse_entry_matrix(a, offset_size)
+
+
+def _pack_entries(
+    keys: np.ndarray,
+    scaled_offs: np.ndarray,
+    sizes: np.ndarray,
+    offset_size: int,
+) -> np.ndarray:
+    """Inverse of _parse_entry_matrix: (n, entry) uint8 rows byte-identical
+    to a pack_entry loop, without per-entry Python."""
+    n = len(keys)
+    entry = needle_map_entry_size(offset_size)
+    a = np.empty((n, entry), dtype=np.uint8)
+    a[:, :8] = (
+        np.ascontiguousarray(keys, dtype=np.uint64)
+        .astype(">u8")
+        .view(np.uint8)
+        .reshape(n, 8)
+    )
+    so = np.ascontiguousarray(scaled_offs, dtype=np.uint64)
+    a[:, 8:12] = (
+        (so & np.uint64(0xFFFFFFFF)).astype(">u4").view(np.uint8).reshape(n, 4)
+    )
+    if offset_size == 5:
+        a[:, 12] = (so >> np.uint64(32)).astype(np.uint8)
+    a[:, 8 + offset_size : 8 + offset_size + 4] = (
+        np.ascontiguousarray(sizes, dtype=np.int64)
+        .astype(">i4")
+        .view(np.uint8)
+        .reshape(n, 4)
+    )
+    return a
 
 
 def replay_idx_vectorized(raw: bytes, offset_size: int):
@@ -131,6 +179,12 @@ class DenseNeedleMap(IdxLogMixin, NeedleMapper):
     """16B/entry packed in-memory kind (compact_map.go analog)."""
 
     MERGE_THRESHOLD = 8192
+    # overflow is also allowed to grow to base/MERGE_RATIO before merging:
+    # a fixed trigger makes every sustained PUT storm pay an O(base) re-sort
+    # per 8192 inserts (quadratic overall); ratio-scaled batches keep the
+    # total merge work O(n log n) — each merge grows the base by ≥1/8, so
+    # per-insert cost is amortized O(1) array work
+    MERGE_RATIO = 8
 
     def __init__(self, index_file: BinaryIO, offset_size: int = OFFSET_SIZE):
         self._lock = threading.Lock()
@@ -144,6 +198,13 @@ class DenseNeedleMap(IdxLogMixin, NeedleMapper):
         # overflow holds only keys NOT in the base (updates to base keys go
         # in place), so lookups check it first and merge is a pure union
         self._overflow: dict[int, tuple[int, int]] = {}
+        self.merge_count = 0  # diagnostic: merges since load
+
+    def _merge_trigger(self) -> int:
+        """Overflow size that forces a merge: MERGE_THRESHOLD is the floor
+        (small bases keep the old behavior), scaled up with the base so
+        merge cost stays amortized under sustained insert storms."""
+        return max(self.MERGE_THRESHOLD, len(self._keys) // self.MERGE_RATIO)
 
     # -- loading (vectorized; no per-entry Python) ---------------------------
     @classmethod
@@ -211,6 +272,7 @@ class DenseNeedleMap(IdxLogMixin, NeedleMapper):
             )
         self._sizes = np.insert(self._sizes, pos, osz)
         self._overflow.clear()
+        self.merge_count += 1
 
     # -- mutations (CompactNeedleMap-identical semantics) --------------------
     def put(self, key: int, offset: int, size: int) -> None:
@@ -224,7 +286,7 @@ class DenseNeedleMap(IdxLogMixin, NeedleMapper):
                     self._base_set(i, offset, size)
                 else:
                     self._overflow[key] = (offset, size)
-                    if len(self._overflow) >= self.MERGE_THRESHOLD:
+                    if len(self._overflow) >= self._merge_trigger():
                         self._merge_overflow()
             self.max_file_key = max(self.max_file_key, key)
             self.file_counter += 1
@@ -470,6 +532,21 @@ class SqliteNeedleMap(IdxLogMixin, NeedleMapper):
             pass
 
 
+def _write_sorted_entries(
+    keys: np.ndarray,
+    scaled_offs: np.ndarray,
+    sizes: np.ndarray,
+    sorted_path: str,
+    offset_size: int,
+) -> None:
+    """Write key-sorted final-state entries atomically (tmp + rename)."""
+    a = _pack_entries(keys, scaled_offs, sizes, offset_size)
+    with open(sorted_path + ".tmp", "wb") as f:
+        f.write(a.tobytes())
+    # sweedlint: ok durability atomic tmp+rename of derived data; the sorted base rebuilds from .idx
+    os.replace(sorted_path + ".tmp", sorted_path)
+
+
 def write_sorted_index(
     idx_raw: bytes, sorted_path: str, offset_size: int = OFFSET_SIZE
 ) -> None:
@@ -477,18 +554,7 @@ def write_sorted_index(
     the input format of the read-only kind (WriteSortedFileFromIdx,
     ec_encoder.go:27 is the .ecx sibling of this)."""
     _, fkeys, foffs, fsizes = replay_idx_vectorized(idx_raw, offset_size)
-    with open(sorted_path + ".tmp", "wb") as f:
-        for i in range(len(fkeys)):
-            f.write(
-                idx_mod.pack_entry(
-                    int(fkeys[i]),
-                    int(foffs[i]) * NEEDLE_PADDING_SIZE,
-                    int(fsizes[i]),
-                    offset_size,
-                )
-            )
-    # sweedlint: ok durability atomic tmp+rename of derived data; .sdx rebuilds from .idx
-    os.replace(sorted_path + ".tmp", sorted_path)
+    _write_sorted_entries(fkeys, foffs, fsizes, sorted_path, offset_size)
 
 
 class SortedFileNeedleMap(IdxLogMixin, NeedleMapper):
@@ -556,3 +622,292 @@ class SortedFileNeedleMap(IdxLogMixin, NeedleMapper):
         self._f.close()
         if self._index_file is not self._f:
             super().close()
+
+
+class MmapNeedleMap(IdxLogMixin, NeedleMapper):
+    """Memory-mapped kind for volumes whose index exceeds RAM even at
+    16 B/entry (the 1e8–1e9-needle hot-shard profile).
+
+    The base (`<volume>.mdx`) is the final .idx replay state, key-sorted in
+    the exact pack_entry byte format of .sdx, mapped read-only with
+    np.memmap: a get() binary-searches the mapping and faults in only the
+    O(log n) pages it touches, so resident memory is page-cache pressure,
+    not heap. Mutations SHADOW the immutable base through an overflow dict
+    (CompactNeedleMap conventions: negative size marks a delete in place)
+    and are batch-merged by atomically rewriting the mapped file with the
+    same ratio-amortized trigger as DenseNeedleMap.
+
+    A JSON sidecar (`<volume>.mdx.meta`) pins the .idx size + counters the
+    base reflects, so a fresh load maps the base without reading the .idx
+    at all (near-zero RSS at any entry count). A stale or missing sidecar —
+    crash between idx appends and the next merge, torn-tail truncation,
+    compaction — rebuilds from the .idx via the vectorized replay (O(idx
+    bytes) transient, nothing resident afterwards). The .idx append log
+    stays the durable source of truth; base + sidecar are derived data.
+    """
+
+    MERGE_THRESHOLD = 8192
+    MERGE_RATIO = 8
+
+    _META_KEYS = SqliteNeedleMap._META_KEYS
+
+    def __init__(
+        self,
+        index_file: BinaryIO,
+        base_path: str,
+        offset_size: int = OFFSET_SIZE,
+    ):
+        self._lock = threading.Lock()
+        self._init_log(index_file, offset_size)
+        self._base_path = base_path
+        self._meta_path = base_path + ".meta"
+        self._entry = needle_map_entry_size(offset_size)
+        self._mm: Optional[np.memmap] = None
+        self._count = 0
+        # overflow shadows the base (the mapping is immutable): updates AND
+        # deletes of base keys live here until the next merge
+        self._overflow: dict[int, tuple[int, int]] = {}
+        self.merge_count = 0
+
+    # -- loading -------------------------------------------------------------
+    @classmethod
+    def load(
+        cls,
+        index_file: BinaryIO,
+        base_path: str,
+        offset_size: int = OFFSET_SIZE,
+    ) -> "MmapNeedleMap":
+        nm = cls(index_file, base_path, offset_size)
+        meta = nm._read_meta()
+        if (
+            meta is not None
+            and meta.get("idx_size") == nm.index_file_size()
+            and meta.get("offset_size") == offset_size
+            and os.path.exists(base_path)
+            and os.path.getsize(base_path)
+            == meta.get("count", -1) * nm._entry
+        ):
+            for k in cls._META_KEYS:
+                setattr(nm, k, int(meta.get(k, 0)))
+            nm._map_base(int(meta["count"]))
+        else:
+            nm._rebuild_from_idx()
+        index_file.seek(0, io.SEEK_END)
+        return nm
+
+    def _read_meta(self) -> Optional[dict]:
+        try:
+            with open(self._meta_path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write_meta(self) -> None:
+        meta = {k: getattr(self, k) for k in self._META_KEYS}
+        meta["idx_size"] = self.index_file_size()
+        meta["offset_size"] = self._offset_size
+        meta["count"] = self._count
+        with open(self._meta_path + ".tmp", "w", encoding="utf-8") as f:
+            json.dump(meta, f)
+        # sweedlint: ok durability derived sidecar; a torn meta just forces an .idx replay on the next load
+        os.replace(self._meta_path + ".tmp", self._meta_path)
+
+    def _map_base(self, count: int) -> None:
+        self._count = count
+        if count > 0:
+            self._mm = np.memmap(  # sweedlint: ok lock-discipline helper; callers hold the lock (put/delete/close) or run in load before the map is shared
+                self._base_path,
+                dtype=np.uint8,
+                mode="r",
+                shape=(count * self._entry,),
+            )
+            # binary search is pure random access: without MADV_RANDOM the
+            # kernel's readahead/fault-around maps whole 64KB clusters per
+            # touched page, ballooning RSS toward the full base size when
+            # the file is warm in page cache
+            raw = getattr(self._mm, "_mmap", None)  # sweedlint: ok lock-discipline helper; callers hold the lock or run in load before the map is shared
+            if raw is not None and hasattr(mmap, "MADV_RANDOM"):
+                raw.madvise(mmap.MADV_RANDOM)
+        else:
+            # np.memmap refuses zero-length files
+            self._mm = None  # sweedlint: ok lock-discipline helper; callers hold the lock or run in load before the map is shared
+
+    def _rebuild_from_idx(self) -> None:
+        self._index_file.seek(0)
+        raw = self._index_file.read()
+        metrics, fkeys, foffs, fsizes = replay_idx_vectorized(
+            raw, self._offset_size
+        )
+        self.__dict__.update(metrics)
+        self._write_base(fkeys, foffs, fsizes)
+
+    def _write_base(
+        self, keys: np.ndarray, scaled_offs: np.ndarray, sizes: np.ndarray
+    ) -> None:
+        # drop our mapping before the rename; a map another thread already
+        # holds stays valid (the replaced inode lives until unmapped)
+        self._mm = None  # sweedlint: ok lock-discipline helper; callers (put/delete/close/load) serialize through the lock
+        _write_sorted_entries(
+            keys, scaled_offs, sizes, self._base_path, self._offset_size
+        )
+        self._map_base(len(keys))
+        self._write_meta()
+
+    # -- base lookups --------------------------------------------------------
+    def _key_at(self, i: int) -> int:
+        s = i * self._entry
+        return int.from_bytes(self._mm[s : s + 8].tobytes(), "big")  # sweedlint: ok lock-discipline read helper under the caller's lock (get/put/delete)
+
+    def _entry_at(self, i: int) -> tuple[int, int, int]:
+        s = i * self._entry
+        return idx_mod.unpack_entry(
+            self._mm[s : s + self._entry].tobytes(), self._offset_size  # sweedlint: ok lock-discipline read helper under the caller's lock (get/put/delete)
+        )
+
+    def _base_find(self, key: int) -> Optional[int]:
+        lo, hi = 0, self._count
+        while lo < hi:
+            mid = (lo + hi) // 2
+            k = self._key_at(mid)
+            if k == key:
+                return mid
+            if k < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        return None
+
+    def _current(self, key: int) -> Optional[tuple[int, int]]:
+        v = self._overflow.get(key)
+        if v is not None:
+            return v
+        i = self._base_find(key)
+        if i is None:
+            return None
+        _, off, size = self._entry_at(i)
+        return off, size
+
+    # -- mutations (CompactNeedleMap-identical semantics) --------------------
+    def put(self, key: int, offset: int, size: int) -> None:
+        with self._lock:
+            old = self._current(key)
+            self._overflow[key] = (offset, size)
+            self.max_file_key = max(self.max_file_key, key)
+            self.file_counter += 1
+            self.file_byte_counter += size
+            if old is not None and old[0] != 0 and size_is_valid(old[1]):
+                self.deletion_counter += 1
+                self.deletion_byte_counter += old[1]
+            self._append_entry(key, offset, size)
+            if len(self._overflow) >= max(
+                self.MERGE_THRESHOLD, self._count // self.MERGE_RATIO
+            ):
+                self._merge_overflow()
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        with self._lock:
+            v = self._current(key)
+        if v is None:
+            return None
+        return NeedleValue(key, v[0], v[1])
+
+    def delete(self, key: int, offset: int) -> None:
+        with self._lock:
+            old = self._current(key)
+            if old is not None and size_is_valid(old[1]):
+                self.deletion_counter += 1
+                self.deletion_byte_counter += old[1]
+                self._overflow[key] = (old[0], -old[1])
+            self._append_entry(key, offset, TOMBSTONE_FILE_SIZE)
+
+    def _merge_overflow(self) -> None:
+        if not self._overflow:
+            return
+        if self._count:
+            a = np.asarray(self._mm).reshape(self._count, self._entry)  # sweedlint: ok lock-discipline merge runs under the put/close caller's lock
+            bkeys, boffs, bsizes = _parse_entry_matrix(a, self._offset_size)
+        else:
+            bkeys = np.empty(0, dtype=np.uint64)
+            boffs = np.empty(0, dtype=np.uint64)
+            bsizes = np.empty(0, dtype=np.int64)
+        ok = np.fromiter(
+            self._overflow.keys(), dtype=np.uint64, count=len(self._overflow)
+        )
+        vals = list(self._overflow.values())
+        ooff = np.array(
+            [v[0] // NEEDLE_PADDING_SIZE for v in vals], dtype=np.uint64
+        )
+        osz = np.array([v[1] for v in vals], dtype=np.int64)
+        order = np.argsort(ok)
+        ok, ooff, osz = ok[order], ooff[order], osz[order]
+        pos = np.searchsorted(bkeys, ok)
+        hit = pos < len(bkeys)
+        hit[hit] = bkeys[pos[hit]] == ok[hit]
+        boffs[pos[hit]] = ooff[hit]
+        bsizes[pos[hit]] = osz[hit]
+        ins = ~hit
+        bkeys = np.insert(bkeys, pos[ins], ok[ins])
+        boffs = np.insert(boffs, pos[ins], ooff[ins])
+        bsizes = np.insert(bsizes, pos[ins], osz[ins])
+        self._overflow.clear()
+        self._write_base(bkeys, boffs, bsizes)
+        self.merge_count += 1
+
+    # -- queries -------------------------------------------------------------
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for nv in self._ascending_items():
+            fn(nv)
+
+    def _ascending_items(self) -> Iterator[NeedleValue]:
+        ov = sorted(self._overflow.items())
+        oi = 0
+        for bi in range(self._count):
+            key, off, size = self._entry_at(bi)
+            while oi < len(ov) and ov[oi][0] < key:
+                k, (o, s) = ov[oi]
+                yield NeedleValue(k, o, s)
+                oi += 1
+            if oi < len(ov) and ov[oi][0] == key:
+                k, (o, s) = ov[oi]  # overflow shadows the base entry
+                yield NeedleValue(k, o, s)
+                oi += 1
+            else:
+                yield NeedleValue(key, off, size)
+        while oi < len(ov):
+            k, (o, s) = ov[oi]
+            yield NeedleValue(k, o, s)
+            oi += 1
+
+    def items(self) -> Iterator[NeedleValue]:
+        return self._ascending_items()
+
+    def __len__(self) -> int:
+        shadowed = sum(
+            1 for k in self._overflow if self._base_find(k) is not None
+        )
+        return self._count + len(self._overflow) - shadowed
+
+    # -- lifecycle -----------------------------------------------------------
+    def release(self) -> None:
+        with self._lock:
+            self._mm = None
+            self._overflow.clear()
+
+    def close(self) -> None:
+        try:
+            with self._lock:
+                self._merge_overflow()
+                self._write_meta()
+                self._mm = None
+        except Exception:  # sweedlint: ok broad-except shutdown close; base+meta are derived, the next load replays the .idx
+            pass
+        super().close()
+
+    def destroy(self) -> None:
+        self.close()
+        for p in (self._base_path, self._meta_path):
+            try:
+                # sweedlint: ok durability destroy path; deletion is the goal and re-running is idempotent
+                os.remove(p)
+            except FileNotFoundError:
+                pass

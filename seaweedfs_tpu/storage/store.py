@@ -22,6 +22,7 @@ from ..ec.codec import Codec, get_codec
 from ..ec.constants import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, TOTAL_SHARDS, shard_ext
 from ..ec.ec_volume import EcVolume, NeedsShardError
 from ..ec.ec_volume import NotFoundError as EcNotFoundError
+from ..stats import heat
 from ..util import faultpoints, glog
 from .commit import StagedCommit
 from .disk_location import DiskLocation
@@ -82,6 +83,7 @@ class Store:
         self.deleted_ec_shards: deque[dict] = deque()
         self.delta_event = threading.Event()
         self._lock = make_rlock("Store._lock")
+        heat.register_store(self)
 
     @property
     def ec_codec(self) -> Codec:
@@ -259,6 +261,7 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
+        v.write_heat.mark()
         return v.write_needle(n, fsync=fsync)
 
     def delete_volume_needle(self, vid: int, n: Needle) -> int:
@@ -269,11 +272,13 @@ class Store:
                 ev.delete_needle(n.id)
                 return 0
             raise NotFoundError(f"volume {vid} not found")
+        v.write_heat.mark()
         return v.delete_needle(n)
 
     def read_volume_needle(self, vid: int, n: Needle) -> int:
         v = self.find_volume(vid)
         if v is not None:
+            v.read_heat.mark()
             return v.read_needle(n)
         ev = self.find_ec_volume(vid)
         if ev is not None:
@@ -287,7 +292,16 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             return None
+        v.read_heat.mark()
         return v.read_needle_extent(n, min_size)
+
+    def note_volume_read(self, vid: int) -> None:
+        """Account a read that was answered without touching the volume
+        (hot-needle cache hit): the heat signal must still see it or the
+        cache would mask exactly the skew placement needs to react to."""
+        v = self.find_volume(vid)
+        if v is not None:
+            v.read_heat.mark()
 
     # -- EC encode: crash-safe two-phase commit ------------------------------
     def ec_encode_volume(self, vid: int) -> list[int]:
@@ -432,6 +446,8 @@ class Store:
             "version": v.version,
             "ttl": v.ttl.to_uint32(),
             "compact_revision": v.super_block.compaction_revision,
+            "read_heat": round(v.read_heat.value(), 3),
+            "write_heat": round(v.write_heat.value(), 3),
         }
 
     def collect_heartbeat(self) -> dict:

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
+from ..stats.heat import EwmaHeat
 from ..util.locks import make_rlock
 from ..util import faultpoints
 from ..util.parsers import tolerant_uint
@@ -98,6 +99,10 @@ class Volume:
         self.last_modified_ts_seconds = 0
         self._lock = make_rlock("Volume._lock")
         self._is_compacting = False
+        # zipfian-skew signal: decayed op counters marked by the store's
+        # routing layer, shipped in heartbeats for heat-aware placement
+        self.read_heat = EwmaHeat()
+        self.write_heat = EwmaHeat()
 
         base = self.file_name()
         tier_exists = os.path.exists(base + ".tier")
@@ -171,6 +176,14 @@ class Volume:
             return SqliteNeedleMap.load(
                 idx_file, self.file_name() + ".ldb", self.offset_size
             )
+        if kind == "mmap":
+            # billion-needle kind: sorted .mdx base memory-mapped read-only
+            # + overflow dict; near-zero RSS at any entry count
+            from .needle_map_dense import MmapNeedleMap
+
+            return MmapNeedleMap.load(
+                idx_file, self.file_name() + ".mdx", self.offset_size
+            )
         if kind == "sorted":
             # read-only kind for sealed volumes (needle_map_sorted_file.go):
             # generate/refresh the .sdx from the .idx, then binary-search it
@@ -209,7 +222,9 @@ class Volume:
         backends, volume-level TTL inheritance)."""
         if self.turbo is not None:  # sweedlint: ok lock-discipline admin pre-check; attach is store-serialized, worst case re-attach returns True
             return True
-        if self.needle_map_kind == "sorted":
+        if self.needle_map_kind in ("sorted", "mmap"):
+            # sorted is sealed/read-only; mmap's base is an immutable
+            # mapping the engine can't own as its writable .idx-backed map
             return False
         # sweedlint: ok lock-discipline admin pre-check; tier moves exclude attach via the store
         if not isinstance(self.data_backend, DiskFile):
@@ -1064,7 +1079,7 @@ class Volume:
             self.close()
             base = self.file_name()
             for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx",
-                        ".note", ".ldb"):
+                        ".note", ".ldb", ".mdx", ".mdx.meta"):
                 try:
                     # sweedlint: ok durability destroy path; deletion is the goal, FileNotFoundError makes re-runs idempotent
                     os.remove(base + ext)

@@ -14,6 +14,10 @@ Kinds
                 the restart gets.
 ``io-error``    raise :class:`FaultError` (an ``OSError`` with ``EIO``).
 ``delay``       ``time.sleep(arg)`` (default 0.05s) — widens race windows.
+``serial-delay`` ``time.sleep(arg)`` under a per-point lock — concurrent
+                hits line up, modeling a queue-depth-1 device (one disk
+                spindle: the hot-shard probe arms this on the needle-read
+                path so load concentration actually queues).
 ``torn-write``  truncate the call site's file to ``arg`` fraction (default
                 0.5) of its current size, then hard-exit — a torn write
                 plus power loss in one step.
@@ -44,7 +48,7 @@ from typing import Optional
 
 CRASH_EXIT_CODE = 113  # distinctive: harnesses assert the fault (not a bug) killed us
 
-KINDS = ("crash", "io-error", "delay", "torn-write")
+KINDS = ("crash", "io-error", "delay", "serial-delay", "torn-write")
 
 
 class FaultError(OSError):
@@ -57,7 +61,8 @@ class FaultError(OSError):
 
 
 class _Point:
-    __slots__ = ("name", "kind", "arg", "skip", "count", "hits", "fired")
+    __slots__ = ("name", "kind", "arg", "skip", "count", "hits", "fired",
+                 "serial")
 
     def __init__(self, name: str, kind: str, arg: Optional[float],
                  skip: int, count: int):
@@ -68,6 +73,9 @@ class _Point:
         self.count = count
         self.hits = 0  # times fire(name) reached this point
         self.fired = 0  # times the fault actually triggered
+        # serial-delay's spindle: NOT the registry lock, so queued sleeps
+        # never block arm/disarm/fire on other points
+        self.serial = threading.Lock() if kind == "serial-delay" else None
 
 
 _points: dict[str, _Point] = {}
@@ -130,7 +138,7 @@ def _fire(name: str, path: Optional[str]) -> None:
             return
         p.fired += 1
         _hit_log[name] = _hit_log.get(name, 0) + 1
-        kind, arg = p.kind, p.arg
+        kind, arg, serial = p.kind, p.arg, p.serial
     try:
         from . import glog
 
@@ -139,6 +147,10 @@ def _fire(name: str, path: Optional[str]) -> None:
         pass
     if kind == "delay":
         time.sleep(arg if arg is not None else 0.05)
+        return
+    if kind == "serial-delay":
+        with serial:
+            time.sleep(arg if arg is not None else 0.05)
         return
     if kind == "io-error":
         raise FaultError(name)

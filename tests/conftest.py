@@ -69,3 +69,8 @@ def pytest_configure(config):
         "armed protocol step, restart recovery invariants asserted); the "
         "fast subset runs in tier-1, the full matrix joins the soak",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute scale soaks (1e8-entry mmap needle map, ...); "
+        "excluded from tier-1 via -m 'not slow'",
+    )

@@ -1,0 +1,12 @@
+"""Fixture: a hot-needle cache miss path materializes the payload from
+the volume file but never closes the handle — resource-leak must fire
+exactly once (the PR 8 cache-populate shape: the real path preads from
+the sendfile extent and closes it in a finally)."""
+
+
+def populate_from_miss(cache, key, cookie, path, off, length):
+    f = open(path, "rb")
+    f.seek(off)
+    data = f.read(length)
+    cache.put(key, cookie, data)
+    return data

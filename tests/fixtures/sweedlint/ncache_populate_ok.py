@@ -1,0 +1,11 @@
+"""Fixture: same populate path as ncache_populate_bad.py, waived —
+sweedlint must report nothing."""
+
+
+def populate_from_miss(cache, key, cookie, path, off, length):
+    # sweedlint: ok resource-leak fixture; the cache owns the handle and closes it on eviction
+    f = open(path, "rb")
+    f.seek(off)
+    data = f.read(length)
+    cache.put(key, cookie, data)
+    return data

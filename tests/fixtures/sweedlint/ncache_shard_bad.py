@@ -1,0 +1,20 @@
+"""Fixture: a cache shard's byte counter is written under the shard lock
+in put() but read lock-free in stats() — lock-discipline must fire
+exactly once (the PR 8 NeedleCache shard shape: the real stats() snapshots
+under the lock)."""
+import threading
+
+
+class Shard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self._entries = {}
+
+    def put(self, key, data):
+        with self._lock:
+            self._entries[key] = data
+            self._bytes += len(data)
+
+    def stats(self):
+        return self._bytes

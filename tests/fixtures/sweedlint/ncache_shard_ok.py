@@ -1,0 +1,18 @@
+"""Fixture: same shard as ncache_shard_bad.py, waived — sweedlint must
+report nothing."""
+import threading
+
+
+class Shard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes = 0
+        self._entries = {}
+
+    def put(self, key, data):
+        with self._lock:
+            self._entries[key] = data
+            self._bytes += len(data)
+
+    def stats(self):
+        return self._bytes  # sweedlint: ok lock-discipline fixture; approximate gauge read of a GIL-atomic int

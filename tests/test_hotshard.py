@@ -1,0 +1,392 @@
+"""Hot-shard path (ISSUE 8): EWMA heat accounting, heat-aware placement,
+the hot-needle RAM cache tier, the CRC scrub, and the hot-shard probe.
+
+The zipfian-storm premise (Haystack/f4): object traffic concentrates on a
+tiny head, so placement must see access frequency and the hottest bytes
+belong in RAM.  These tests pin the unit semantics (decay math, sharded
+LRU, weighted picks, balance plans) and the wiring (heartbeat → layout,
+GET path → cache, /_status gauges) end to end on a live mini-cluster.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster.topology import VolumeInfo
+from seaweedfs_tpu.cluster.volume_layout import (
+    OVERLOAD_FACTOR,
+    VolumeLayout,
+    seed_placement,
+)
+from seaweedfs_tpu.server.http_util import http_bytes, http_json
+from seaweedfs_tpu.shell.commands import _heat_balance_plan
+from seaweedfs_tpu.stats.heat import EwmaHeat
+from seaweedfs_tpu.storage.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.util.needle_cache import NeedleCache
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------- EWMA heat
+def test_ewma_heat_decay(monkeypatch):
+    from seaweedfs_tpu.stats import heat as heat_mod
+
+    now = [1000.0]
+    monkeypatch.setattr(heat_mod.time, "monotonic", lambda: now[0])
+    h = EwmaHeat(halflife=10.0)
+    assert h.value() == 0.0
+    h.mark(8)
+    assert h.value() == pytest.approx(8.0)
+    now[0] += 10.0  # one half-life
+    assert h.value() == pytest.approx(4.0)
+    h.mark(4)  # decayed 4 + fresh 4
+    assert h.value() == pytest.approx(8.0)
+    now[0] += 20.0  # two half-lives
+    assert h.value() == pytest.approx(2.0)
+
+
+def test_ewma_heat_thread_safety():
+    h = EwmaHeat(halflife=3600.0)  # negligible decay during the test
+
+    def hammer():
+        for _ in range(1000):
+            h.mark()
+
+    ts = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert h.value() == pytest.approx(4000.0, rel=0.01)
+
+
+# ------------------------------------------------------ hot-needle cache
+def test_needle_cache_hit_miss_cookie():
+    c = NeedleCache(capacity_bytes=1 << 20)
+    assert c.get(1, 10, 0xAB) is None  # cold miss
+    c.put(1, 10, 0xAB, b"payload")
+    assert c.get(1, 10, 0xAB) == b"payload"
+    # wrong cookie is a miss (the disk read would 404 too), entry stays
+    assert c.get(1, 10, 0xCD) is None
+    assert c.get(1, 10, 0xAB) == b"payload"
+    c.invalidate(1, 10)
+    assert c.get(1, 10, 0xAB) is None
+    st = c.stats()
+    assert st["hits"] == 2 and st["misses"] == 3
+    assert st["hit_ratio"] == pytest.approx(0.4)
+
+
+def test_needle_cache_disabled_and_resize():
+    c = NeedleCache()  # capacity 0 = disabled (the SWEED_NCACHE default)
+    assert not c.enabled
+    c.put(1, 1, 1, b"x")
+    assert c.get(1, 1, 1) is None
+    assert c.stats()["hits"] == 0 and c.stats()["misses"] == 0
+    c.set_capacity(1 << 16)
+    assert c.enabled and c.would_cache(100)
+    c.put(1, 1, 1, b"x")
+    assert c.get(1, 1, 1) == b"x"
+    c.set_capacity(0)  # live shrink evicts everything immediately
+    assert c.stats()["entries"] == 0 and not c.enabled
+
+
+def test_needle_cache_eviction_budget():
+    c = NeedleCache(capacity_bytes=16 * 100, shards=1)  # one 1600B shard
+    for i in range(100):
+        c.put(1, i, 7, bytes(100))
+    st = c.stats()
+    assert st["bytes"] <= 1600
+    assert st["entries"] == 16
+    assert st["evictions"] == 84
+    # LRU: the newest entries survived
+    assert c.get(1, 99, 7) is not None
+    assert c.get(1, 0, 7) is None
+    # an entry over the per-shard budget is refused outright
+    assert not c.would_cache(1601)
+    c.put(1, 500, 7, bytes(1601))
+    assert c.get(1, 500, 7) is None
+
+
+# ----------------------------------------------- heat-weighted placement
+class _FakeDC:
+    def __init__(self, id="dc1"):
+        self.id = id
+
+
+class _FakeNode:
+    def __init__(self, name, free=10):
+        self.name = name
+        self._free = free
+
+    def free_space(self):
+        return self._free
+
+    def get_data_center(self):
+        return _FakeDC()
+
+    def __repr__(self):
+        return self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        return isinstance(other, _FakeNode) and other.name == self.name
+
+
+def _layout_with(vol_heats, free=10):
+    """One node per volume, heat per vid from ``vol_heats``."""
+    vl = VolumeLayout(
+        ReplicaPlacement.from_string("000"), TTL(), volume_size_limit=1 << 30
+    )
+    nodes = {}
+    for vid, h in vol_heats.items():
+        dn = _FakeNode(f"n{vid}", free=free)
+        nodes[vid] = dn
+        vl.register_volume(
+            VolumeInfo(id=vid, size=0, read_heat=h, write_heat=0.0), dn
+        )
+    return vl, nodes
+
+
+def test_pick_for_write_prefers_cold_volumes():
+    seed_placement(42)
+    vl, _ = _layout_with({1: 0.0, 2: 2000.0})
+    picks = {1: 0, 2: 0}
+    for _ in range(300):
+        vid, _locs = vl.pick_for_write()
+        picks[vid] += 1
+    # weight ∝ 1/(1+heat): the hot volume should get ~0.05% of picks
+    assert picks[1] > 290, picks
+    assert vl.stats()["heat"] == {"2": 2000.0}
+
+
+def test_pick_for_write_skips_overloaded_nodes():
+    seed_placement(7)
+    # node heat: n1=9000 (overloaded vs mean), n2=0, n3=0
+    vl, _ = _layout_with({1: 9000.0, 2: 0.0, 3: 0.0})
+    assert OVERLOAD_FACTOR * (9000.0 / 3) < 9000.0  # sanity: n1 filtered
+    for _ in range(100):
+        vid, _locs = vl.pick_for_write()
+        assert vid in (2, 3)
+
+
+def test_pick_for_write_overload_fallback():
+    """When every candidate's replicas are overloaded the filter falls
+    back to the full list — degraded placement beats refusing writes."""
+    seed_placement(7)
+    vl, _ = _layout_with({1: 9000.0})
+    assert vl.pick_for_write()[0] == 1
+
+
+def test_seed_placement_is_deterministic():
+    vl, _ = _layout_with({1: 5.0, 2: 5.0, 3: 5.0, 4: 5.0})
+    seed_placement(123)
+    a = [vl.pick_for_write()[0] for _ in range(20)]
+    seed_placement(123)
+    b = [vl.pick_for_write()[0] for _ in range(20)]
+    assert a == b
+
+
+# ----------------------------------------------------- heat balance plan
+def _vol(vid, server, heat):
+    return {"id": vid, "server": server, "read_heat": heat, "write_heat": 0.0}
+
+
+def test_heat_balance_plan_splits_hot_node():
+    a, b = "hosta:8080", "hostb:8080"
+    nodes = [{"url": a}, {"url": b}]
+    vols = [
+        _vol(1, a, 1.0), _vol(2, a, 1.0),
+        _vol(5, b, 800.0), _vol(6, b, 700.0),
+        _vol(7, b, 600.0), _vol(8, b, 500.0),
+    ]
+    plan = _heat_balance_plan(vols, nodes)
+    assert plan, "hot node must shed volumes"
+    assert all(m["from"] == b and m["to"] == a for m in plan)
+    # replaying the plan must land both nodes near the mean
+    heat = {a: 2.0, b: 2600.0}
+    for m in plan:
+        heat[m["from"]] -= m["heat"]
+        heat[m["to"]] += m["heat"]
+    assert max(heat.values()) <= 0.7 * 2600.0
+
+
+def test_heat_balance_plan_rejects_dominant_swap():
+    """One volume carrying ~all the heat can't be split by moving it —
+    swapping it to the other node is churn with no p99 payoff, so the
+    plan must come back empty (that skew is the cache tier's job)."""
+    a, b = "hosta:8080", "hostb:8080"
+    nodes = [{"url": a}, {"url": b}]
+    vols = [_vol(1, a, 1.0), _vol(8, b, 5000.0), _vol(7, b, 10.0)]
+    assert _heat_balance_plan(vols, nodes) == []
+
+
+def test_heat_balance_plan_cold_cluster_noop():
+    nodes = [{"url": "a:1"}, {"url": "b:1"}]
+    vols = [_vol(1, "a:1", 0.0), _vol(2, "b:1", 0.0)]
+    assert _heat_balance_plan(vols, nodes) == []
+    assert _heat_balance_plan([], nodes) == []
+    assert _heat_balance_plan(vols, [{"url": "a:1"}]) == []
+
+
+# -------------------------------------------------- serial-delay faultpoint
+def test_faultpoint_serial_delay_queues():
+    """serial-delay models a queue-depth-1 device: concurrent fires line
+    up, so N threads take ≥ N×arg wall-clock (plain delay would overlap)."""
+    from seaweedfs_tpu.util import faultpoints
+
+    faultpoints.arm("t.serial", "serial-delay", arg=0.05, count=0)
+    try:
+        t0 = time.perf_counter()
+        ts = [
+            threading.Thread(target=faultpoints.fire, args=("t.serial",))
+            for _ in range(4)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert time.perf_counter() - t0 >= 4 * 0.05
+        assert faultpoints.hits("t.serial") == 4
+    finally:
+        faultpoints.reset()
+
+
+# ----------------------------------------------------- live mini-cluster
+@pytest.fixture()
+def hot_cluster(tmp_path, monkeypatch):
+    """Master + volume server with the cache enabled, the scrub running,
+    and turbo off so the Python data plane (where heat is accounted) is
+    the measured path."""
+    monkeypatch.setenv("SWEED_TURBO", "0")
+    monkeypatch.setenv("SWEED_NCACHE", str(1 << 20))
+    monkeypatch.setenv("SWEED_SCRUB", "1")
+    monkeypatch.setenv("SWEED_SCRUB_RATE", "500")
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    volume = VolumeServer(
+        [str(tmp_path / "v")],
+        port=free_port(),
+        master_url=master.url,
+        max_volume_count=10,
+        pulse_seconds=0.5,
+    ).start()
+    yield master, volume
+    volume.stop()
+    master.stop()
+
+
+def test_hot_cluster_cache_heat_scrub(hot_cluster):
+    master, volume = hot_cluster
+    a = http_json("GET", f"http://{master.url}/dir/assign")
+    fid, url = a["fid"], a["url"]
+    body = b"hot needle payload " * 10
+    st, _ = http_bytes("POST", f"http://{url}/{fid}", body)
+    assert st == 201
+
+    # miss populates, hit serves identical bytes
+    st, got = http_bytes("GET", f"http://{url}/{fid}")
+    assert (st, got) == (200, body)
+    st, got = http_bytes("GET", f"http://{url}/{fid}")
+    assert (st, got) == (200, body)
+    hb = http_json("GET", f"http://{url}/status")
+    assert hb["ncache"]["enabled"]
+    assert hb["ncache"]["hits"] >= 1
+    assert hb["ncache"]["entries"] >= 1
+    # reads marked volume heat (cache hits included via note_volume_read)
+    assert hb["heat"]["read_heat"] > 0.0
+    assert hb["heat"]["write_heat"] > 0.0
+
+    # a range request is served out of the cached entry
+    st, part = http_bytes(
+        "GET", f"http://{url}/{fid}", headers={"Range": "bytes=4-9"}
+    )
+    assert (st, part) == (206, body[4:10])
+
+    # overwrite invalidates: the next GET must see the new bytes
+    st, _ = http_bytes("POST", f"http://{url}/{fid}", b"fresh bytes")
+    assert st == 201
+    st, got = http_bytes("GET", f"http://{url}/{fid}")
+    assert (st, got) == (200, b"fresh bytes")
+
+    # live resize through the admin endpoint
+    r = http_json("POST", f"http://{url}/admin/ncache?capacity=0")
+    assert not r["enabled"]
+    r = http_json("POST", f"http://{url}/admin/ncache?capacity=65536")
+    assert r["enabled"] and r["capacity"] == 65536
+
+    # heartbeats carry the heat to the master's layout stats
+    deadline = time.monotonic() + 10
+    heat_seen = {}
+    while time.monotonic() < deadline and not heat_seen:
+        s = http_json("GET", f"http://{master.url}/dir/status")
+        for lay in s["topology"]["layouts"].values():
+            if lay.get("heat"):
+                heat_seen = lay["heat"]
+        time.sleep(0.3)
+    assert heat_seen, "volume heat never reached the master layout"
+
+    # the background scrub CRC-checks needles and counts rounds
+    deadline = time.monotonic() + 15
+    scrub = {}
+    while time.monotonic() < deadline:
+        scrub = http_json("GET", f"http://{url}/status")["scrub"]
+        if scrub["needles_checked"] > 0 and scrub["rounds"] > 0:
+            break
+        time.sleep(0.3)
+    assert scrub["needles_checked"] > 0, scrub
+    assert scrub["crc_errors"] == 0, scrub
+
+
+def test_status_exposes_prometheus_gauges(hot_cluster):
+    _, volume = hot_cluster
+    st, text = http_bytes(
+        "GET", f"http://{volume.store.public_url}/metrics"
+    )
+    assert st == 200
+    for family in (b"sweed_heat_read", b"sweed_ncache_hits_total",
+                   b"sweed_scrub_needles_checked_total"):
+        assert family in text, family
+
+
+# ------------------------------------------------------ probe smoke test
+def test_bench_probe_hotshard_smoke():
+    """Fast end-to-end run of bench.py --probe-hotshard: tiny corpus,
+    real multi-process cluster (mmap kind, aio serving, serialized seek
+    faultpoint).  Guards the plumbing and the zero-failure byte-verified
+    contract — the ≥2× p99 acceptance bar is only meaningful at the
+    multi-million-needle scale the full probe runs at."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--probe-hotshard", "4000", "600"],
+        capture_output=True, text=True, timeout=240, cwd=repo, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["needle_map_kind"] == "mmap"
+    for phase in ("baseline", "after_balance", "after_cache"):
+        st = out[phase]
+        assert st["n"] == 600, st
+        assert st["failed"] == 0 and st["mismatched"] == 0, (phase, st)
+    assert out["cache_hit_ratio"] > 0.5
+    assert isinstance(out["balance_moved"], list)
+    assert out["p99_improvement"] is not None

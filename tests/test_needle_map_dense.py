@@ -20,6 +20,7 @@ from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage.needle_map import CompactNeedleMap
 from seaweedfs_tpu.storage.needle_map_dense import (
     DenseNeedleMap,
+    MmapNeedleMap,
     SortedFileNeedleMap,
     SqliteNeedleMap,
 )
@@ -52,10 +53,14 @@ def load_kind(kind, raw, tmp_path, offset_size=4):
         return SqliteNeedleMap.load(
             f, str(tmp_path / f"nm_{offset_size}.ldb"), offset_size
         )
+    if kind == "mmap":
+        return MmapNeedleMap.load(
+            f, str(tmp_path / f"nm_{offset_size}.mdx"), offset_size
+        )
     raise ValueError(kind)
 
 
-@pytest.mark.parametrize("kind", ["dense", "sqlite"])
+@pytest.mark.parametrize("kind", ["dense", "sqlite", "mmap"])
 def test_load_parity_with_dict_kind(kind, tmp_path):
     raw = random_history()
     ref = load_kind("memory", raw, tmp_path)
@@ -77,13 +82,13 @@ def test_load_parity_with_dict_kind(kind, tmp_path):
     assert seen == seen_ref
 
 
-@pytest.mark.parametrize("kind", ["dense", "sqlite"])
+@pytest.mark.parametrize("kind", ["dense", "sqlite", "mmap"])
 def test_mutation_parity(kind, tmp_path):
     """Runtime put/get/delete sequences must match the dict kind exactly,
-    including overflow→base merges in the dense kind."""
+    including overflow→base merges in the dense and mmap kinds."""
     ref = CompactNeedleMap(io.BytesIO())
     nm = load_kind(kind, b"", tmp_path)
-    if kind == "dense":
+    if kind in ("dense", "mmap"):
         nm.MERGE_THRESHOLD = 50  # force several merges
     rng = random.Random(3)
     offset = 8
@@ -221,7 +226,7 @@ def test_volume_with_each_kind(tmp_path):
         Volume,
     )
 
-    for kind in ("memory", "dense", "sqlite"):
+    for kind in ("memory", "dense", "sqlite", "mmap"):
         d = tmp_path / kind
         d.mkdir()
         v = Volume(str(d), "", 1, needle_map_kind=kind)
@@ -245,6 +250,67 @@ def test_volume_with_each_kind(tmp_path):
         v2.read_needle(n)
         assert n.data == b"x" * 80
         v2.close()
+
+
+def test_mmap_reopen_and_crash_replay(tmp_path):
+    """A clean reopen maps the .mdx base via the sidecar (no .idx replay);
+    an .idx that grew past the committed sidecar forces a full replay."""
+    raw = random_history(500, 100)
+    base = str(tmp_path / "v.mdx")
+    nm = MmapNeedleMap.load(io.BytesIO(raw), base, 4)
+    fc, dc = nm.file_count(), nm.deleted_count()
+    snap = {k: nm.get(k) for k in range(1, 110)}
+    nm.release()
+    # clean reopen: sidecar matches idx size → base mapped as-is
+    nm2 = MmapNeedleMap.load(io.BytesIO(raw), base, 4)
+    assert (nm2.file_count(), nm2.deleted_count()) == (fc, dc)
+    assert {k: nm2.get(k) for k in range(1, 110)} == snap
+    nm2.release()
+    # crash simulation: idx appends landed after the last merge/meta write
+    raw2 = raw + idx_mod.pack_entry(7, 1 << 20, 999)
+    nm3 = MmapNeedleMap.load(io.BytesIO(raw2), base, 4)
+    assert nm3.get(7).size == 999
+    nm3.release()
+    # torn sidecar: must fall back to replay, not crash
+    with open(base + ".meta", "w") as f:
+        f.write('{"idx_size": 1')
+    nm4 = MmapNeedleMap.load(io.BytesIO(raw2), base, 4)
+    assert nm4.get(7).size == 999
+    assert nm4.file_count() == nm3.file_count()
+    nm4.release()
+
+
+def test_mmap_destroy_removes_base_and_sidecar(tmp_path):
+    raw = random_history(100, 40)
+    base = str(tmp_path / "d.mdx")
+    nm = MmapNeedleMap.load(io.BytesIO(raw), base, 4)
+    nm.close()
+    assert os.path.exists(base) and os.path.exists(base + ".meta")
+    nm2 = MmapNeedleMap.load(io.BytesIO(raw), base, 4)
+    nm2.destroy()
+    assert not os.path.exists(base)
+    assert not os.path.exists(base + ".meta")
+
+
+@pytest.mark.parametrize("cls", [DenseNeedleMap, MmapNeedleMap])
+def test_merge_amortization(cls, tmp_path, monkeypatch):
+    """Merges must be ratio-amortized: the overflow budget grows with the
+    base, so N sequential puts trigger O(log N) merges, not N/threshold.
+    Regression guard for the billion-needle write path — a fixed trigger
+    makes insertion O(N²/threshold) in total merge work."""
+    monkeypatch.setattr(cls, "MERGE_THRESHOLD", 64)
+    if cls is DenseNeedleMap:
+        nm = DenseNeedleMap.load(io.BytesIO(), 4)
+    else:
+        nm = MmapNeedleMap.load(io.BytesIO(), str(tmp_path / "a.mdx"), 4)
+    n = 20_000
+    for k in range(1, n + 1):
+        nm.put(k, k * 8, 100)
+    # fixed-threshold behavior would be n/64 = 312 merges; the amortized
+    # trigger max(threshold, base/ratio) caps it near ratio*log2(n/threshold)
+    assert nm.merge_count <= 80, nm.merge_count
+    assert nm.get(n).offset == n * 8
+    assert nm.file_count() == n
 
 
 def rss_kb():
@@ -289,3 +355,63 @@ def test_million_needle_memory_bound(tmp_path):
     assert nm.get(500_000).offset == (500_000 - 1) * 128 + 8
     assert nm.bytes_per_entry() <= 17.0
     assert delta_kb <= 32 * 1024, f"RSS delta {delta_kb}KB > 32MB"
+
+
+def _write_sorted_idx(path, n, chunk=5_000_000):
+    """Stream an n-entry key-sorted .idx to disk in bounded chunks."""
+    with open(path, "wb") as f:
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            m = hi - lo
+            keys = np.arange(lo + 1, hi + 1, dtype=np.uint64)
+            entry = np.zeros((m, 16), dtype=np.uint8)
+            entry[:, :8] = keys[:, None].view(np.uint8).reshape(m, 8)[:, ::-1]
+            offs = (np.arange(lo, hi, dtype=np.uint64) * 128 + 8) // 8
+            entry[:, 8:12] = offs.astype(">u4").view(np.uint8).reshape(m, 4)
+            entry[:, 12:16] = (
+                np.full(m, 100, dtype=">i4").view(np.uint8).reshape(m, 4)
+            )
+            entry.tofile(f)
+
+
+@pytest.mark.slow
+def test_mmap_hundred_million_entry_soak(tmp_path):
+    """ISSUE 8 acceptance: the mmap kind loads a 1e8-entry index (1.6GB of
+    .idx) with RSS below 10% of the index size.  The first load pays the
+    one-time vectorized replay that builds the .mdx base; the measured
+    reopen maps the base through the sidecar — observed delta is a few KB,
+    and a replay regression (reading the whole .idx back into heap) would
+    blow the 10% budget by an order of magnitude.  The 2000-get sweep runs
+    AFTER the RSS assertion: lookup fault-in is clean page cache the
+    kernel reclaims under pressure, and with the base warm in cache a
+    single fault maps a whole folio (up to 2MB on large-folio kernels,
+    MADV_RANDOM notwithstanding), so its resident size is a kernel
+    tunable, not a property of this code — the boot-cost claim is what
+    the budget pins."""
+    n = 100_000_000
+    idx_path = tmp_path / "soak.idx"
+    _write_sorted_idx(str(idx_path), n)
+    idx_size = os.path.getsize(idx_path)
+    assert idx_size == n * 16
+    base = str(tmp_path / "soak.mdx")
+    with open(idx_path, "rb") as f:
+        nm = MmapNeedleMap.load(f, base, 4)  # builds base + sidecar
+        assert len(nm) == n
+        nm.release()
+
+    rss_base = rss_kb()
+    with open(idx_path, "rb") as f:
+        nm = MmapNeedleMap.load(f, base, 4)
+        delta_kb = rss_kb() - rss_base
+        rng = random.Random(11)
+        for _ in range(2000):
+            k = rng.randrange(1, n + 1)
+            v = nm.get(k)
+            assert v is not None and v.offset == (k - 1) * 128 + 8
+        assert nm.get(n + 7) is None
+        nm.release()
+    budget_kb = idx_size // 10 // 1024
+    assert delta_kb <= budget_kb, (
+        f"reopen RSS delta {delta_kb}KB > 10% of index ({budget_kb}KB) — "
+        "the sidecar fast path should map, not read"
+    )

@@ -37,6 +37,10 @@ CASES = [
     ("blocking-under-lock", "blocking_under_lock", "storage/fixture.py"),
     ("blocking-on-loop", "blocking_on_loop", "server/fixture.py"),
     ("tainted-size", "tainted_size", "server/fixture.py"),
+    # PR 8 hot-needle cache shapes: the populate path must not leak the
+    # extent handle, the shard counters stay behind the shard lock
+    ("resource-leak", "ncache_populate", "server/fixture.py"),
+    ("lock-discipline", "ncache_shard", "storage/fixture.py"),
 ]
 
 
