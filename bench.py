@@ -70,6 +70,57 @@ def _sustained_rate(run_chain, bytes_per_iter: int, short: int = 32,
     return sustained, raw
 
 
+# -- tile autotune sidecar -----------------------------------------------------
+# The alt-geometry probes (RS(6,3)/RS(12,4)) historically swung ~50% between
+# runs because every run RE-SWEPT tiles under a wall-clock guard: a slow host
+# truncated the sweep at a different tile each time and published whatever it
+# had. Warm-first protocol instead: the FIRST run sweeps (it is the warmup —
+# its number is the sweep's best, and the winning tile is persisted to a JSON
+# sidecar); every later run loads the pinned tile and measures ONLY it, so
+# run-to-run spread is the kernel's own, not the tile lottery's.
+
+def _tile_cache_path() -> str:
+    """SWEED_TILE_CACHE > ~/.cache/sweed_tile.json > repo-local fallback
+    (CI containers with read-only or absent home directories)."""
+    env = os.environ.get("SWEED_TILE_CACHE")
+    if env:
+        return env
+    cache_dir = os.path.expanduser("~/.cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        probe = os.path.join(cache_dir, ".sweed_tile_probe")
+        with open(probe, "w"):
+            pass
+        os.remove(probe)
+        return os.path.join(cache_dir, "sweed_tile.json")
+    except OSError:
+        return os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".sweed_tile.json"
+        )
+
+
+def _tile_cache_load() -> dict:
+    try:
+        with open(_tile_cache_path()) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _tile_cache_store(key: str, entry: dict) -> None:
+    path = _tile_cache_path()
+    d = _tile_cache_load()
+    d[key] = entry
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(d, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:  # cache is an optimization; the bench must not die
+        log(f"tile cache write failed ({path}): {e}")
+
+
 def probe_encode(chunk_mb: int, tile_kb: int) -> None:
     """Child mode: time encode for one config, print one float (GB/s)."""
     import jax
@@ -1175,13 +1226,37 @@ def probe_extras(sweep_guard_s: float = 240.0) -> None:
         0, 256, (10, 100 * 1024 * 1024), dtype=np.uint8
     )
     cpu.encode(giga[:, : 1024 * 1024])  # warm
+    # sustained = reused parity buffer, the streaming-encoder scenario
+    # (encoder.py passes out= per chunk; klauspost's Go benchmarks likewise
+    # reuse the shard slices) — allocating 400 MB of parity per call costs
+    # mmap + first-touch page faults comparable to the GFNI kernel itself
+    parity_buf = np.empty((cpu.parity_shards, giga.shape[1]), dtype=np.uint8)
     runs = []
     for _ in range(3):
         t0 = time.perf_counter()
-        cpu.encode(giga)
+        cpu.encode(giga, out=parity_buf)
         runs.append(1.0 * giga.size / (time.perf_counter() - t0) / 1e9)
     out["cpu_encode_gbps"] = round(max(runs), 3)
     out["cpu_encode_runs_gbps"] = [round(r, 3) for r in runs]
+    del parity_buf
+    t0 = time.perf_counter()
+    cpu.encode(giga)
+    out["cpu_encode_fresh_gbps"] = round(
+        1.0 * giga.size / (time.perf_counter() - t0) / 1e9, 3
+    )
+    # before/after: the same kernel WITHOUT the cached prep blob — the
+    # multiply tables are re-derived inside the call, which is the exact
+    # r05 code path — published next to the r05 baseline so the artifact
+    # shows what the prep cache + GFNI tier bought without digging through
+    # old BENCH files
+    matrix = np.ascontiguousarray(cpu.parity_rows, dtype=np.uint8)
+    t0 = time.perf_counter()
+    cpu._lib.rs_matmul(matrix, giga)
+    out["cpu_encode_noprep_gbps"] = round(
+        1.0 * giga.size / (time.perf_counter() - t0) / 1e9, 3
+    )
+    out["cpu_encode_r05_baseline_gbps"] = 1.33  # BENCH_r05 published rate
+    out["cpu_encode_vs_r05"] = round(out["cpu_encode_gbps"] / 1.33, 2)
     del giga
 
     @jax.jit
@@ -1193,13 +1268,24 @@ def probe_extras(sweep_guard_s: float = 240.0) -> None:
     # RS(10,4) probe: r4 pinned these to 32KB and published RS(6,3) well
     # below the range the README claimed; the sweep finds each geometry's
     # own best tile, bounded by a wall-clock guard (compiles dominate).
+    # Warm-first: a pinned tile in the sidecar (see _tile_cache_path)
+    # collapses the sweep to that single tile — the ~50% run-to-run swing
+    # on these geometries was the guard truncating the sweep at a
+    # different tile each run, not kernel variance.
     t_extras = time.perf_counter()
     n = 32 * 1024 * 1024
     # historically-best tile FIRST per geometry (r5 probes: RS(6,3) peaked
     # at 64KB — 88.6 vs 59.3 GB/s at 32KB; RS(12,4) at 32KB) so the
     # wall-clock guard stopping the sweep early still keeps the best config
     tile_order = {(6, 3): (64, 32, 128, 16), (12, 4): (32, 64, 16, 128)}
+    dev_kind = jax.devices()[0].device_kind
+    tile_cache = _tile_cache_load()
     for (k, m), tiles in tile_order.items():
+        cache_key = f"rs{k},{m}:{dev_kind}"
+        pin = tile_cache.get(cache_key, {}).get("tile_kb")
+        pinned = pin in tiles
+        if pinned:
+            tiles = (pin,)
         # one input buffer per geometry (tile-invariant): regenerating it
         # per tile would waste the sweep's own wall budget, and a stale
         # reference pinned by the run closure would keep two resident
@@ -1227,6 +1313,13 @@ def probe_extras(sweep_guard_s: float = 240.0) -> None:
         del buf
         out[f"rs{k}{m}_encode_gbps"] = round(best_g, 2)
         out[f"rs{k}{m}_tile_kb"] = best_tile
+        out[f"rs{k}{m}_tile_pinned"] = pinned
+        if best_tile is not None and not pinned:
+            _tile_cache_store(cache_key, {
+                "tile_kb": best_tile,
+                "gbps": round(best_g, 2),
+                "device": dev_kind,
+            })
 
     # 1-missing-data-shard reconstruct (the common degraded-read case —
     # decode is a (1 × 10) matmul instead of the 4-row worst case); big
@@ -1288,6 +1381,105 @@ def probe_extras(sweep_guard_s: float = 240.0) -> None:
     # the rate trails encode because a 1-missing decode has 8 output bit
     # rows vs encode's 32 on the 128-row MXU tile — skinny-output
     # utilization, not a dispatch fallback (the fused kernel runs here)
+    print(json.dumps(out))
+
+
+def probe_roofline(n_mb: int = 256, guard_s: float = 240.0) -> None:
+    """Child mode: the memory-bandwidth roofline behind the encode plateau.
+
+    Two measurements, one JSON line:
+
+    * ``stream_copy_gbps`` — a jitted uint8 ``x + 1`` chained through an
+      ``n_mb`` buffer (each link reads + writes every byte, data dependence
+      prevents elision). That is the STREAM-style practical HBM ceiling
+      this runtime reaches — no arithmetic to hide behind, so no kernel
+      can legitimately move bytes faster.
+    * ``tiles[]`` — achieved RS(10,4) GF-matmul HBM traffic (read k·n,
+      write m·n per op; the per-op checksum's extra parity read is NOT
+      counted, so the fraction is conservative) at several tile sizes,
+      each as a fraction of the copy ceiling.
+
+    Interpretation: the ~75 GB/s input-rate encode plateau is
+    memory-bound iff the best tile's ``roofline_frac`` sits near 1.0 —
+    then no tile/kernel tweak moves the headline, only bandwidth does. A
+    tile whose fraction falls off is kernel-bound at that shape (VMEM
+    re-streaming), which is tuning headroom, not a hardware wall.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ec.codec import TpuCodec
+
+    t_start = time.perf_counter()
+    width = 32 * 1024 * 1024
+    chain = (8, 40)
+    if jax.default_backend() == "cpu":
+        # host-memory roofline is still meaningful, but CPU XLA runs the
+        # bit-matmul ~100x slower — shrink so the probe fits its timeout
+        n_mb = min(n_mb, 64)
+        width = 4 * 1024 * 1024
+        chain = (2, 8)
+    out = {"buffer_mb": n_mb, "device": jax.devices()[0].device_kind}
+
+    @jax.jit
+    def checksum(x):
+        return jnp.sum(x, dtype=jnp.uint32)
+
+    @jax.jit
+    def stream(x):
+        return x + jnp.uint8(1)
+
+    n = n_mb * 1024 * 1024
+    buf = jax.random.bits(jax.random.PRNGKey(0), (n,), dtype=jnp.uint8)
+    buf.block_until_ready()
+    stream(buf).block_until_ready()  # warm/compile
+
+    def run_copy(iters):
+        y = buf
+        for _ in range(iters):
+            y = stream(y)
+        _ = int(checksum(y))
+
+    ceiling, raw = _sustained_rate(
+        run_copy, 2 * n, short=chain[0], long_=chain[1]
+    )
+    out["stream_copy_gbps"] = round(ceiling, 2)
+    out["stream_copy_raw_gbps"] = round(raw, 2)
+    del buf
+
+    k_, m_ = 10, 4
+    data = jax.random.bits(jax.random.PRNGKey(1), (k_, width), dtype=jnp.uint8)
+    data.block_until_ready()
+    tiles_out = []
+    for tile_kb in (8, 16, 32, 64, 128):
+        if tiles_out and time.perf_counter() - t_start > guard_s:
+            out["truncated_at_tile_kb"] = tile_kb  # no silent caps
+            break
+        try:
+            codec = TpuCodec(pallas_tile=tile_kb * 1024)
+            _ = int(checksum(codec.matmul_device(codec.parity_rows, data)))
+        except Exception as e:  # noqa: BLE001 — tile too big for VMEM etc.
+            tiles_out.append({"tile_kb": tile_kb, "error": str(e)[:120]})
+            continue
+
+        def run(iters, codec=codec):
+            acc = None
+            for _ in range(iters):
+                s = checksum(codec.matmul_device(codec.parity_rows, data))
+                acc = s if acc is None else acc + s
+            _ = int(acc)
+
+        enc, _r = _sustained_rate(
+            run, k_ * width, short=chain[0], long_=chain[1]
+        )
+        del run
+        hbm = enc * (k_ + m_) / k_
+        entry = {"tile_kb": tile_kb, "encode_gbps": round(enc, 2),
+                 "hbm_gbps": round(hbm, 2)}
+        if ceiling > 0:
+            entry["roofline_frac"] = round(hbm / ceiling, 3)
+        tiles_out.append(entry)
+    out["tiles"] = tiles_out
     print(json.dumps(out))
 
 
@@ -1754,6 +1946,19 @@ def main() -> None:
     except subprocess.TimeoutExpired:
         log("extras probe timed out")
 
+    # -- roofline: streaming-copy HBM ceiling vs GF-matmul bytes/s ------------
+    roofline = None
+    try:
+        r = _run_probe(["--probe-roofline", "256", "240"], timeout=700)
+        if r.returncode == 0 and r.stdout.strip():
+            roofline = json.loads(r.stdout.strip().splitlines()[-1])
+            log(f"roofline: {roofline}")
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            log(f"roofline probe failed: {tail[0][:140]}")
+    except subprocess.TimeoutExpired:
+        log("roofline probe timed out")
+
     # -- query pushdown: vectorized scan vs pure-Python engine (CPU-only) -----
     query_bench = None
     try:
@@ -1784,6 +1989,7 @@ def main() -> None:
                 ),
                 "rebuild": rebuild,
                 "extras": extras,
+                "roofline": roofline,
                 "mesh_single_chip_gbps": mesh_gbps,
                 "smallfile": smallfile,
                 "filer_pipe": filer_pipe,
@@ -1822,6 +2028,9 @@ if __name__ == "__main__":
         probe_rebuild_stream(int(sys.argv[2]), int(sys.argv[3]))
     elif sys.argv[1:2] == ["--probe-extras"]:
         probe_extras(float(sys.argv[2]) if len(sys.argv) > 2 else 240.0)
+    elif sys.argv[1:2] == ["--probe-roofline"]:
+        probe_roofline(int(sys.argv[2]) if len(sys.argv) > 2 else 256,
+                       float(sys.argv[3]) if len(sys.argv) > 3 else 240.0)
     elif sys.argv[1:2] == ["--probe-query"]:
         probe_query(int(sys.argv[2]) if len(sys.argv) > 2 else 256)
     elif len(sys.argv) >= 4 and sys.argv[1] == "--probe-smallfile":

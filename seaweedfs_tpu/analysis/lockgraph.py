@@ -71,6 +71,29 @@ _TRANSPORT_FUNCS = frozenset(
     }
 )
 
+#: jax.lax cross-device collectives: dispatching one is a synchronization
+#: point for EVERY process in the mesh, so doing it while holding a product
+#: lock convoys the whole fleet behind one node's lock (and deadlocks
+#: outright if another mesh member needs that lock to reach its own
+#: dispatch).  jax.distributed.* (initialize/shutdown barriers) and
+#: multihost_utils.* (process_allgather & friends) block on their peers the
+#: same way.
+_COLLECTIVE_NAMES = frozenset(
+    {
+        "psum",
+        "psum_scatter",
+        "pmean",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "all_to_all",
+        "ppermute",
+        "pshuffle",
+        "axis_index_groups",
+    }
+)
+_MESH_MODULES = ("jax.distributed", "jax.experimental.multihost_utils")
+
 
 @dataclass(frozen=True)
 class Site:
@@ -186,6 +209,8 @@ class LockGraphBuilder:
         self._lock_order_v: list[Violation] = []
         self._blocking_v: list[Violation] = []
         self._loop_v: list[Violation] = []
+        self._collective_v: list[Violation] = []
+        self._col_summaries: dict[str, dict[str, Site]] = {}
         self._collect_decls()
         self._build()
         self._build_loop_rule()
@@ -354,6 +379,81 @@ class LockGraphBuilder:
             return f"pooled transport {callee.name}"
         return None
 
+    def _is_collective_call(
+        self, call: ast.Call, fi: FuncInfo, env: dict
+    ) -> Optional[str]:
+        """Short description when the call dispatches a jax collective /
+        mesh synchronization point, else None."""
+        p = self.project
+        mi = p.modules[fi.modname]
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            mod = p._expr_module(f.value, mi)
+            if mod is not None:
+                if mod in ("jax.lax", "lax") and f.attr in _COLLECTIVE_NAMES:
+                    return f"jax.lax.{f.attr}"
+                for mesh_mod in _MESH_MODULES:
+                    if mod == mesh_mod or mod.endswith(
+                        "." + mesh_mod.rsplit(".", 1)[-1]
+                    ):
+                        return f"{mesh_mod}.{f.attr}"
+                if f.attr == "shard_map":
+                    return "shard_map dispatch"
+        elif isinstance(f, ast.Name):
+            kind_target = mi.symbols.get(f.id)
+            if kind_target and kind_target[0] == "symbol":
+                target = kind_target[1]
+                mod, _, name = target.rpartition(".")
+                if mod in ("jax.lax", "lax") and name in _COLLECTIVE_NAMES:
+                    return f"jax.lax.{name}"
+                if any(target.startswith(m + ".") for m in _MESH_MODULES):
+                    return target
+                if name == "shard_map":
+                    return "shard_map dispatch"
+        return None
+
+    def _collective_in(
+        self, fi: FuncInfo, depth: int, seen: frozenset
+    ) -> dict[str, Site]:
+        """description → first site of a collective dispatch reachable
+        from fi (the collective mirror of :meth:`_blocking_in`)."""
+        if fi.qualname in self._col_summaries:
+            return self._col_summaries[fi.qualname]
+        if depth <= 0 or fi.qualname in seen:
+            return {}
+        seen = seen | {fi.qualname}
+        out: dict[str, Site] = {}
+        env = self.cg.local_types(fi)
+
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                if isinstance(child, ast.Call):
+                    desc = self._is_collective_call(child, fi, env)
+                    if desc is not None:
+                        out.setdefault(desc, Site(fi.relpath, child.lineno, ""))
+                    else:
+                        callee = self.cg.resolve_call(child, fi, env)
+                        if callee is not None and callee.qualname not in seen:
+                            for desc, s in self._collective_in(
+                                callee, depth - 1, seen
+                            ).items():
+                                chain = f"via {callee.name}" + (
+                                    f" {s.chain}" if s.chain else ""
+                                )
+                                out.setdefault(
+                                    desc, Site(fi.relpath, child.lineno, chain)
+                                )
+                visit(child)
+
+        visit(fi.node)
+        if depth == MAX_DEPTH:
+            self._col_summaries[fi.qualname] = out
+        return out
+
     def _blocking_in(self, fi: FuncInfo, depth: int, seen: frozenset) -> dict[str, Site]:
         """description → first site of a blocking op reachable from fi."""
         if fi.qualname in self._blk_summaries:
@@ -482,6 +582,26 @@ class LockGraphBuilder:
                         )
                     )
             return
+        cdesc = self._is_collective_call(call, fi, env)
+        if cdesc is not None:
+            if in_scope:
+                key = (fi.relpath, call.lineno, cdesc)
+                if key not in blocking_seen:
+                    blocking_seen.add(key)
+                    self._collective_v.append(
+                        Violation(
+                            "collective-under-lock",
+                            fi.relpath,
+                            call.lineno,
+                            f"{cdesc} while holding {held[-1]}; a mesh "
+                            "collective synchronizes EVERY process, so one "
+                            "node's lock convoys the fleet (deadlock if a "
+                            "peer needs the lock to reach its own "
+                            "dispatch) — dispatch outside the lock "
+                            "(docs/ANALYSIS.md)",
+                        )
+                    )
+            return
         callee = self.cg.resolve_call(call, fi, env)
         if callee is None:
             return
@@ -513,6 +633,27 @@ class LockGraphBuilder:
                         f"reachable while holding {held[-1]}; release the "
                         "lock around the slow call and re-validate state "
                         "after (docs/LOCKS.md)",
+                    )
+                )
+            collectives = self._collective_in(
+                callee, MAX_DEPTH - 1, frozenset({fi.qualname})
+            )
+            for desc, s in sorted(collectives.items()):
+                key = (fi.relpath, call.lineno, desc)
+                if key in blocking_seen:
+                    continue
+                blocking_seen.add(key)
+                chain = f"{callee.name}" + (f" {s.chain}" if s.chain else "")
+                self._collective_v.append(
+                    Violation(
+                        "collective-under-lock",
+                        fi.relpath,
+                        call.lineno,
+                        f"{desc} (via {chain}, {s.relpath}:{s.line}) "
+                        f"reachable while holding {held[-1]}; a mesh "
+                        "collective synchronizes EVERY process, so one "
+                        "node's lock convoys the fleet — dispatch outside "
+                        "the lock (docs/ANALYSIS.md)",
                     )
                 )
 
@@ -604,7 +745,11 @@ class LockGraphBuilder:
 
     # -- violations -----------------------------------------------------------
     def violations(self) -> list[Violation]:
-        out = list(self._blocking_v) + list(self._loop_v)
+        out = (
+            list(self._blocking_v)
+            + list(self._loop_v)
+            + list(self._collective_v)
+        )
         for cycle in self.graph.cycles():
             cyc = set(cycle)
             sites: list[tuple[str, int, str]] = []

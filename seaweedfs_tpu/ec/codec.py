@@ -38,11 +38,18 @@ class Codec:
         """(R×k GF matrix) @ (k×N bytes) → (R×N bytes). Backend-specific."""
         raise NotImplementedError
 
+    # Backends whose matmul accepts an ``out=`` result buffer (reused across
+    # streaming chunks — allocating a fresh parity buffer per call costs page
+    # faults comparable to the matmul itself at native-kernel rates).
+    supports_out = False
+
     # -- public API ----------------------------------------------------------
-    def encode(self, data: np.ndarray) -> np.ndarray:
+    def encode(self, data: np.ndarray, out: "np.ndarray | None" = None) -> np.ndarray:
         """data (k, N) → parity (m, N)."""
         if data.shape[0] != self.data_shards:
             raise ValueError(f"expected {self.data_shards} data rows, got {data.shape[0]}")
+        if out is not None and self.supports_out:
+            return self.matmul(self.parity_rows, data, out=out)
         return self.matmul(self.parity_rows, data)
 
     def encode_shards(self, data: np.ndarray) -> np.ndarray:
@@ -112,30 +119,89 @@ class Codec:
 
 
 class NumpyCodec(Codec):
-    """Pure-numpy GF matmul via the 256×256 table. Oracle-grade, not fast."""
+    """Pure-numpy GF matmul: low/high-nibble product tables gathered with
+    ``np.take`` over contiguous column blocks. GF(2^8) multiplication is
+    GF(2)-linear, so mul(c, v) == mul(c, v & 0x0F) ^ mul(c, v & 0xF0) exactly
+    — same bytes as the 256×256-table oracle loop, but the gathers hit two
+    cache-resident 16-entry tables and the ≤256 KB block working set stays
+    in L2 across the whole (r, c) loop nest. The tables are derived once per
+    matrix (gf.nibble_tables) and cached, mirroring the native kernel's prep
+    blob — the old path walked the full mul table per call."""
 
-    def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
-        mt = gf.get_mul_table()
-        out = np.zeros((matrix.shape[0], data.shape[1]), dtype=np.uint8)
-        for r in range(matrix.shape[0]):
-            for c in range(matrix.shape[1]):
-                coef = matrix[r, c]
-                if coef:
-                    out[r] ^= mt[coef, data[c]]
+    _BLOCK = 1 << 16  # per-row block bytes; (k+R)·block stays L2-resident
+    supports_out = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._tab_cache: dict[bytes, np.ndarray] = {}
+
+    def _tables(self, matrix: np.ndarray) -> np.ndarray:
+        key = matrix.tobytes()
+        cached = self._tab_cache.get(key)
+        if cached is None:
+            cached = gf.nibble_tables(matrix)
+            self._tab_cache[key] = cached
+        return cached
+
+    def matmul(
+        self,
+        matrix: np.ndarray,
+        data: np.ndarray,
+        out: "np.ndarray | None" = None,
+    ) -> np.ndarray:
+        tabs = self._tables(matrix)  # (R, k, 2, 16)
+        rows, k = matrix.shape
+        n = data.shape[1]
+        if out is None:
+            out = np.zeros((rows, n), dtype=np.uint8)
+        else:
+            out[:] = 0
+        for pos in range(0, n, self._BLOCK):
+            blk = data[:, pos : pos + self._BLOCK]
+            lo_idx = blk & 0x0F
+            hi_idx = blk >> 4
+            for r in range(rows):
+                acc = out[r, pos : pos + self._BLOCK]
+                for c in range(k):
+                    if not matrix[r, c]:
+                        continue
+                    acc ^= np.take(tabs[r, c, 0], lo_idx[c])
+                    acc ^= np.take(tabs[r, c, 1], hi_idx[c])
         return out
 
 
 class CpuCodec(Codec):
-    """C++ native kernel (seaweedfs_tpu/native)."""
+    """C++ native kernel (seaweedfs_tpu/native). The kernel's per-matrix
+    coefficient prep (GFNI affine qwords / PSHUFB nibble tables, depending
+    on the build) is derived once and cached here — encode calls the same
+    parity matrix forever, and rederiving the tables per call was the
+    cold-start cliff in BENCH_r05's cpu_encode_runs_gbps."""
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         from seaweedfs_tpu.native import lib
 
         self._lib = lib
+        self._prep_cache: dict[bytes, np.ndarray] = {}
 
-    def matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
-        return self._lib.rs_matmul(matrix, data)
+    def _prep(self, matrix: np.ndarray) -> np.ndarray:
+        key = matrix.tobytes()
+        cached = self._prep_cache.get(key)
+        if cached is None:
+            cached = self._lib.rs_prep(matrix)
+            self._prep_cache[key] = cached
+        return cached
+
+    supports_out = True
+
+    def matmul(
+        self,
+        matrix: np.ndarray,
+        data: np.ndarray,
+        out: "np.ndarray | None" = None,
+    ) -> np.ndarray:
+        matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+        return self._lib.rs_matmul(matrix, data, prep=self._prep(matrix), out=out)
 
 
 def build_pallas_gf_matmul(jax, n_out_rows: int, k: int, n_cols: int,

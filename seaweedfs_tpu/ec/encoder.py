@@ -316,6 +316,11 @@ def write_ec_files(
             _encode_pipelined(dat, items, codec, outputs, dat_size,
                               stats=pipeline_stats)
         else:
+            # the parity buffer is consumed (written out) before the next
+            # chunk encodes, so one buffer serves the whole stream — a fresh
+            # allocation per chunk pays first-touch page faults comparable
+            # to the native kernel's own runtime
+            parity_buf = None
             with open(dat, "rb") as f:
                 for item in items:
                     faultpoints.fire("ec.encode.chunk", path=outputs[0].name)
@@ -329,7 +334,12 @@ def write_ec_files(
                         for o in outputs:
                             o.seek(width, 1)
                         continue
-                    parity = codec.encode(data)
+                    if getattr(codec, "supports_out", False):
+                        if parity_buf is None or parity_buf.shape[1] != data.shape[1]:
+                            parity_buf = np.empty((m, data.shape[1]), dtype=np.uint8)
+                        parity = codec.encode(data, out=parity_buf)
+                    else:
+                        parity = codec.encode(data)
                     for i in range(k):
                         outputs[i].write(data[i].tobytes())
                     for j in range(m):

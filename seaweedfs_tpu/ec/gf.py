@@ -90,6 +90,23 @@ def get_mul_table() -> np.ndarray:
     return _MUL_TABLE
 
 
+def nibble_tables(matrix: np.ndarray) -> np.ndarray:
+    """Low/high-nibble product tables for every coefficient of ``matrix``:
+    shape (R, k, 2, 16) uint8 where [r, c, 0, x] = matrix[r,c]·x and
+    [r, c, 1, x] = matrix[r,c]·(x<<4). Multiplication is GF(2)-linear, so
+    mul(c, v) == lo[v & 0x0F] ^ hi[v >> 4] exactly (klauspost's PSHUFB
+    table formulation, derived host-side for the numpy fallback)."""
+    mt = get_mul_table()
+    coefs = np.ascontiguousarray(matrix, dtype=np.uint8).reshape(-1)
+    lo = mt[coefs][:, np.arange(16)]
+    hi = mt[coefs][:, np.arange(16) << 4]
+    return (
+        np.stack([lo, hi], axis=1)
+        .reshape(*matrix.shape, 2, 16)
+        .astype(np.uint8, copy=True)
+    )
+
+
 # -- matrices (uint8 2-D numpy arrays) ---------------------------------------
 def mat_mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """Matrix product over GF(2^8)."""

@@ -19,6 +19,7 @@ import time
 import urllib.parse
 from typing import Optional
 
+from ..cluster.fleet import EcJobScheduler
 from ..cluster.master import Master
 from ..cluster.topology import DataNode
 from ..stats import serving_stats
@@ -55,6 +56,11 @@ class MasterServer:
         self.node_timeout = node_timeout
         self._nodes: dict[str, DataNode] = {}
         self._lock = make_lock("MasterServer._lock")
+        # fleet EC scheduler: fans encode/rebuild jobs over the mesh-backed
+        # volume servers (cluster/fleet.py); membership rides heartbeats
+        self.fleet = EcJobScheduler(
+            locate=lambda vid: self.master.lookup_volume(vid, "")
+        )
         self._srv = None
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -193,6 +199,10 @@ class MasterServer:
                 )
                 self._nodes[url] = dn
             ack = self.master.handle_heartbeat(dn, hb)
+        # mesh coordinates ride the beat (SWEED_MESH=1 volume servers):
+        # the fleet scheduler's membership is exactly heartbeat freshness
+        if "mesh" in hb:
+            self.fleet.observe_member(url, hb.get("mesh"))
         # announce the leader so volume servers re-point after failover
         # (volume_grpc_client_to_master.go:155-197 recv loop)
         ack["leader"] = self.election.leader
@@ -254,7 +264,45 @@ class MasterServer:
             "locks": lock_stats(),
             # serving-core counters (mode, inflight, admission shedding)
             "serving": serving_stats(),
+            # fleet EC scheduler: mesh members + job ledger (sweed_fleet_*)
+            "fleet": self.fleet.stats(),
         }
+
+    # -- fleet EC scheduling (cluster/fleet.py) ------------------------------
+    def _h_fleet_encode(self, h, path, q, body):
+        """POST /ec/fleet/encode?volumeIds=1,2,3[&collection=c][&wait=1]:
+        fan /admin/ec/generate over the volume holders (mesh members
+        preferred). With wait=1 the response carries settled job states —
+        the shell's -fleet path uses that to spread shards afterwards."""
+        raw = q.get("volumeIds", q.get("volumeId", ""))
+        vids = [tolerant_uint(v, None) for v in raw.split(",") if v.strip()]
+        if not vids or None in vids:
+            return 400, {"error": f"bad volumeIds={raw!r}"}
+        collection = q.get("collection", "")
+        jids = [self.fleet.submit("encode", vid, collection) for vid in vids]
+        settled = True
+        if q.get("wait") == "1":
+            settled = self.fleet.wait(
+                jids, timeout=tolerant_ufloat(q.get("timeout", ""), 600.0)
+            )
+        return 200, {
+            "jobs": [self.fleet.job_info(j) for j in jids],
+            "settled": settled,
+        }
+
+    def _h_fleet_rebuild(self, h, path, q, body):
+        vid = tolerant_uint(q.get("volumeId", ""), None)
+        if vid is None:
+            return 400, {"error": f"bad volumeId={q.get('volumeId')!r}"}
+        jid = self.fleet.submit("rebuild", vid, q.get("collection", ""))
+        if q.get("wait") == "1":
+            self.fleet.wait(
+                [jid], timeout=tolerant_ufloat(q.get("timeout", ""), 600.0)
+            )
+        return 200, {"jobs": [self.fleet.job_info(jid)]}
+
+    def _h_fleet_status(self, h, path, q, body):
+        return 200, self.fleet.stats()
 
     def _h_ui(self, h, path, q, body):
         """Embedded status page (server/master_ui analog)."""
@@ -337,6 +385,7 @@ class MasterServer:
         if dn is None:
             return 404, {"error": f"unknown node {url}"}
         self.master.handle_node_disconnect(dn)
+        self.fleet.drop_member(url)
         return 200, {"left": url}
 
     def _reap_loop(self):
@@ -353,6 +402,7 @@ class MasterServer:
                     if now - dn.last_seen > timeout:
                         self.master.handle_node_disconnect(dn)
                         del self._nodes[url]
+                        self.fleet.drop_member(url)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -370,6 +420,12 @@ class MasterServer:
                 ("POST", "/col/delete", ms._leader_only(ms._h_col_delete)),
                 ("POST", "/cluster/lock", ms._leader_only(ms._h_lock)),
                 ("POST", "/cluster/unlock", ms._leader_only(ms._h_unlock)),
+                # fleet EC scheduling: only the leader's topology knows the
+                # live members, so followers proxy like other admin writes
+                ("POST", "/ec/fleet/encode", ms._leader_only(ms._h_fleet_encode)),
+                ("POST", "/ec/fleet/rebuild",
+                 ms._leader_only(ms._h_fleet_rebuild)),
+                ("GET", "/ec/fleet/status", ms._leader_only(ms._h_fleet_status)),
                 # reads proxy too: only the leader's topology is fed by
                 # heartbeats, so followers answer through it (the reference
                 # wraps these handlers in proxyToLeader as well)
@@ -398,6 +454,7 @@ class MasterServer:
     def stop(self):
         self._stop.set()
         self.election.stop()
+        self.fleet.stop()
         if self._srv:
             self._srv.shutdown()
             self._srv.server_close()

@@ -130,6 +130,9 @@ class VolumeServer:
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._scrub_thread: Optional[threading.Thread] = None
+        # jax.distributed coordinates (SWEED_MESH=1); reported to the master
+        # in every heartbeat so its fleet scheduler sees mesh membership
+        self.mesh_info: Optional[dict] = None
 
     # -- remote EC shard read via master shard lookup ------------------------
     def _remote_shard_reader(self, vid, shard_id, offset, size):
@@ -852,11 +855,20 @@ class VolumeServer:
         .ecx/.vif — staged and committed atomically so a crash mid-encode
         can never leave a half-visible shard set (Store.ec_encode_volume)."""
         vid = _q_req_uint(q, "volume")
+        v = self.store.find_volume(vid)
+        nbytes = v.size() if v is not None else 0
+        t0 = time.monotonic()
         try:
             shards = self.store.ec_encode_volume(vid)
         except NotFoundError:
             return 404, {"error": "volume not found"}
-        return 200, {"shards": shards}
+        # bytes + wall time let the master's fleet scheduler keep a
+        # per-member encode-GB/s ledger without a second round trip
+        return 200, {
+            "shards": shards,
+            "bytes": nbytes,
+            "seconds": time.monotonic() - t0,
+        }
 
     def _h_ec_rebuild(self, h, path, q, body):
         vid = _q_req_uint(q, "volume")
@@ -1351,6 +1363,8 @@ class VolumeServer:
         hb["data_center"] = self.data_center
         hb["rack"] = self.rack
         hb["max_volume_count"] = self.max_volume_count
+        if self.mesh_info is not None:
+            hb["mesh"] = self.mesh_info
         ack = http_json(
             "POST", f"http://{self.master_url}/cluster/heartbeat", hb, timeout=10
         )
@@ -1428,8 +1442,53 @@ class VolumeServer:
             i = -1
         self.master_url = self.master_seeds[(i + 1) % len(self.master_seeds)]
 
+    def _init_mesh(self) -> None:
+        """SWEED_MESH=1: join the fleet's jax.distributed mesh BEFORE any
+        codec work runs (jax.distributed.initialize must precede the first
+        backend touch — startup ordering in docs/SCALING.md). Coordinates
+        come from the environment:
+
+            SWEED_MESH_COORDINATOR    host:port of process 0 (empty ⇒ this
+                                      node is a 1-process mesh; no
+                                      coordination service is started)
+            SWEED_MESH_PROCESS_ID     this server's process index
+            SWEED_MESH_NUM_PROCESSES  fleet size
+
+        Failure is survivable: the server still serves, reports
+        initialized=false in heartbeats, and the master's fleet scheduler
+        simply stops preferring it for mesh work.
+        """
+        coordinator = os.environ.get("SWEED_MESH_COORDINATOR", "")
+        num = tolerant_uint(os.environ.get("SWEED_MESH_NUM_PROCESSES"), 1) or 1
+        pid = tolerant_uint(os.environ.get("SWEED_MESH_PROCESS_ID"), 0) or 0
+        self.mesh_info = {
+            "coordinator": coordinator,
+            "process_id": pid,
+            "num_processes": num,
+            "initialized": False,
+        }
+        try:
+            if coordinator and num > 1:
+                import jax
+
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=num,
+                    process_id=pid,
+                )
+                self.mesh_info["local_devices"] = jax.local_device_count()
+            self.mesh_info["initialized"] = True
+            glog.info(
+                "mesh member up: process %d/%d (coordinator %s)",
+                pid, num, coordinator or "<self>",
+            )
+        except Exception as e:  # noqa: BLE001 — degraded, not dead
+            glog.warning("jax.distributed.initialize failed: %s", e)
+
     # -- lifecycle -----------------------------------------------------------
     def start(self):
+        if os.environ.get("SWEED_MESH") == "1" and self.mesh_info is None:
+            self._init_mesh()
         vs = self
 
         class Handler(JsonHandler):

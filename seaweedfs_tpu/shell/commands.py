@@ -179,7 +179,20 @@ def ec_encode(
     r = http_json("POST", f"http://{source}/admin/ec/generate?volume={vid}")
     if r.get("error"):
         raise RuntimeError(f"generate: {r['error']}")
+    return _spread_and_finish(env, vid, collection, source, locations,
+                              delete_original)
 
+
+def _spread_and_finish(
+    env: CommandEnv,
+    vid: int,
+    collection: str,
+    source: str,
+    locations: list[str],
+    delete_original: bool,
+) -> dict:
+    """Post-generate half of doEcEncode: spread the 14 shards round-robin,
+    mount everywhere, drop the plain volume."""
     plan = _spread_plan(env, source)
     for target, shard_ids in plan.items():
         if target == source or not shard_ids:
@@ -203,6 +216,65 @@ def ec_encode(
         for url in locations:
             http_json("POST", f"http://{url}/admin/delete_volume?volume={vid}")
     return {"volume": vid, "spread": {t: s for t, s in plan.items() if s}}
+
+
+def ec_encode_fleet(
+    env: CommandEnv,
+    vids: list[int],
+    collection: Optional[str] = None,
+    delete_original: bool = True,
+) -> dict:
+    """ec.encode -fleet: mark every volume readonly, hand the whole batch to
+    the MASTER's fleet scheduler (POST /ec/fleet/encode — it fans
+    /admin/ec/generate across the mesh-registered holders in parallel, each
+    staged-commit protected), then spread/mount/drop per volume exactly as
+    the single-volume path does. One shell process no longer serializes the
+    fleet's encode throughput."""
+    if not vids:
+        raise RuntimeError("ec.encode -fleet: no volume ids")
+    locations: dict[int, list[str]] = {}
+    collections: dict[int, str] = {}
+    for vid in vids:
+        locs = env.volume_locations(vid)
+        if not locs:
+            raise RuntimeError(f"volume {vid} not found")
+        locations[vid] = locs
+        collections[vid] = (
+            collection
+            if collection
+            else _volume_collection(env, vid)
+        )
+        volume_mark_readonly(env, vid)
+
+    ids = ",".join(str(v) for v in vids)
+    r = http_json(
+        "POST",
+        f"http://{env.master}/ec/fleet/encode?volumeIds={ids}"
+        f"&collection={collection or ''}&wait=1",
+        timeout=600,
+    )
+    if r.get("error"):
+        raise RuntimeError(f"fleet encode: {r['error']}")
+    jobs = {j["volume"]: j for j in r.get("jobs", []) if j}
+    failed = [
+        f"volume {v}: {j.get('error') or j.get('state')}"
+        for v, j in jobs.items()
+        if j.get("state") != "done"
+    ]
+    if failed or len(jobs) < len(vids):
+        raise RuntimeError("fleet encode failed: " + "; ".join(
+            failed or ["missing job results"]
+        ))
+
+    out = {"volumes": [], "jobs": list(jobs.values())}
+    for vid in vids:
+        # the scheduler encoded on a holder; spread FROM that server
+        source = jobs[vid].get("server") or locations[vid][0]
+        out["volumes"].append(
+            _spread_and_finish(env, vid, collections[vid], source,
+                               locations[vid], delete_original)
+        )
+    return out
 
 
 def _spread_plan(env: CommandEnv, source: str) -> dict[str, list[int]]:

@@ -27,7 +27,10 @@ HELP = """commands:
   volumeServer.evacuate -node=host:port         drain a server
   volumeServer.leave -node=host:port            deregister a server now
   volume.fsck [-apply=true]                     find orphan needles vs filer
-  ec.encode -volumeId=N [-collection=C]   erasure-code + spread a volume
+  ec.encode -volumeId=N[,M..] [-collection=C] [-fleet]
+                 erasure-code + spread volume(s); -fleet hands the batch to
+                 the master's scheduler, which fans generate jobs across
+                 the mesh-registered volume servers in parallel
   ec.decode -volumeId=N [-collection=C]   turn an EC volume back to normal
   ec.rebuild -volumeId=N                  rebuild missing shards
   ec.balance                              even out shard spread
@@ -216,9 +219,12 @@ def run_command(env: CommandEnv, line: str) -> object:
     if cmd == "volume.fix.replication":
         return C.volume_fix_replication(env)
     if cmd == "ec.encode":
-        return C.ec_encode(
-            env, int(flags["volumeId"]), flags.get("collection", "")
-        )
+        vids = [int(v) for v in flags["volumeId"].split(",") if v.strip()]
+        if flags.get("fleet") == "true":
+            return C.ec_encode_fleet(env, vids, flags.get("collection", ""))
+        if len(vids) != 1:
+            raise ValueError("multiple -volumeId values require -fleet")
+        return C.ec_encode(env, vids[0], flags.get("collection", ""))
     if cmd == "ec.decode":
         return C.ec_decode(
             env, int(flags["volumeId"]), flags.get("collection", "")

@@ -366,6 +366,61 @@ def register_ncache_metrics(registry: Optional[Registry] = None) -> None:
 register_ncache_metrics()
 
 
+def register_fleet_metrics(registry: Optional[Registry] = None) -> None:
+    """Gauges over the master's fleet EC scheduler (cluster/fleet.py):
+    job counts plus a per-member encode-GB/s gauge keyed by server url."""
+
+    def _snap(key):
+        from ..cluster.fleet import fleet_stats
+
+        return fleet_stats().get(key, 0)
+
+    reg = registry if registry is not None else default_registry
+    reg.gauge(
+        "sweed_fleet_members",
+        "volume servers reporting jax.distributed mesh coordinates",
+    ).set_function(lambda: _snap("members"))
+    reg.gauge(
+        "sweed_fleet_jobs_scheduled_total",
+        "EC jobs accepted by the fleet scheduler",
+    ).set_function(lambda: _snap("jobs_scheduled"))
+    reg.gauge(
+        "sweed_fleet_jobs_running",
+        "EC jobs queued or in flight on a member",
+    ).set_function(lambda: _snap("jobs_running"))
+    reg.gauge(
+        "sweed_fleet_jobs_done_total",
+        "EC jobs that committed their shard set",
+    ).set_function(lambda: _snap("jobs_done"))
+    reg.gauge(
+        "sweed_fleet_jobs_failed_total",
+        "EC jobs that errored (member death, missing volume, ...)",
+    ).set_function(lambda: _snap("jobs_failed"))
+
+    gbps = reg.gauge(
+        "sweed_fleet_member_encode_gbps",
+        "last observed encode throughput per member (volume bytes / wall s)",
+    )
+
+    def _push_members():
+        # per-member label sets are dynamic: refresh them on every read and
+        # report the aggregate count (exposition shows the labeled values)
+        from ..cluster.fleet import fleet_stats
+
+        per = fleet_stats().get("member_gbps", {})
+        for url, v in per.items():
+            gbps.set(v, member=url)
+        return len(per)
+
+    reg.gauge(
+        "sweed_fleet_members_measured",
+        "members with at least one completed encode job",
+    ).set_function(_push_members)
+
+
+register_fleet_metrics()
+
+
 def register_scrub_metrics(
     registry: Optional[Registry] = None,
 ) -> dict[str, Counter]:

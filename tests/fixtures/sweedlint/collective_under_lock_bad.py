@@ -1,0 +1,17 @@
+"""Fixture: jax.lax.psum dispatched while holding the lock —
+collective-under-lock must fire exactly once, at the psum call. A mesh
+collective synchronizes every process, so one node's lock convoys the
+whole fleet."""
+import threading
+
+import jax
+
+
+class MeshEncoder:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def encode_step(self, bits):
+        with self._lock:
+            out = jax.lax.psum(bits, "tp")
+        return out

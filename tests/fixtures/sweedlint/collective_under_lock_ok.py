@@ -1,0 +1,16 @@
+"""Fixture: same psum-under-lock as collective_under_lock_bad.py, waived
+with a reason — sweedlint must report nothing."""
+import threading
+
+import jax
+
+
+class MeshEncoder:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def encode_step(self, bits):
+        with self._lock:
+            # sweedlint: ok collective-under-lock fixture: single-process mesh, no peer can hold this lock
+            out = jax.lax.psum(bits, "tp")
+        return out

@@ -238,4 +238,48 @@ def test_native_kernel_reports_variant():
     without AVX2 (BENCH r4 recorded 0.028 GB/s with no provenance)."""
     from seaweedfs_tpu.native import lib
 
-    assert lib.kernel_variant() in ("avx2", "scalar")
+    assert lib.kernel_variant() in ("gfni", "avx2", "scalar")
+
+
+def test_encode_out_buffer_reuse_byte_identical(codecs, data):
+    """encode(data, out=buf) must return buf and match the fresh-alloc
+    result exactly — the streaming encoder reuses one parity buffer per
+    chunk stream (allocating one per call costs first-touch page faults
+    comparable to the GFNI kernel itself)."""
+    for name in ("numpy", "cpu"):
+        codec = codecs[name]
+        ref = codec.encode(data)
+        buf = np.empty_like(ref)
+        buf.fill(0xA7)  # stale garbage must be fully overwritten
+        got = codec.encode(data, out=buf)
+        assert got is buf, name
+        assert np.array_equal(got, ref), name
+        # second reuse on different data — no state leaks through the buffer
+        data2 = data[:, ::-1].copy()
+        assert np.array_equal(codec.encode(data2, out=buf), codec.encode(data2)), name
+    # a codec without out= support silently ignores it (fresh allocation)
+    tpu = codecs["tpu"]
+    assert not getattr(tpu, "supports_out", False)
+    assert np.array_equal(
+        tpu.encode(data, out=np.empty((tpu.parity_shards, data.shape[1]), np.uint8)),
+        codecs["numpy"].encode(data),
+    )
+
+
+def test_rs_matmul_out_validation():
+    """A wrong-shape/dtype/layout out buffer is rejected, not written past."""
+    pytest.importorskip("seaweedfs_tpu.native")
+    from seaweedfs_tpu.native import lib
+
+    matrix = CpuCodec().parity_rows
+    d = np.arange(10 * 1024, dtype=np.uint8).reshape(10, 1024)
+    ok = np.empty((4, 1024), dtype=np.uint8)
+    assert np.array_equal(lib.rs_matmul(matrix, d, out=ok), lib.rs_matmul(matrix, d))
+    for bad in (
+        np.empty((4, 512), dtype=np.uint8),        # wrong width
+        np.empty((3, 1024), dtype=np.uint8),       # wrong rows
+        np.empty((4, 1024), dtype=np.uint16),      # wrong dtype
+        np.empty((4, 2048), dtype=np.uint8)[:, ::2],  # non-contiguous
+    ):
+        with pytest.raises(ValueError):
+            lib.rs_matmul(matrix, d, out=bad)

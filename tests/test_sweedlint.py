@@ -36,6 +36,7 @@ CASES = [
     ("lock-order", "lock_order", "cluster/fixture.py"),
     ("blocking-under-lock", "blocking_under_lock", "storage/fixture.py"),
     ("blocking-on-loop", "blocking_on_loop", "server/fixture.py"),
+    ("collective-under-lock", "collective_under_lock", "server/fixture.py"),
     ("tainted-size", "tainted_size", "server/fixture.py"),
     # PR 8 hot-needle cache shapes: the populate path must not leak the
     # extent handle, the shard counters stay behind the shard lock
