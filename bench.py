@@ -1098,6 +1098,147 @@ def probe_hotshard(n_needles: int, n_requests: int) -> None:
     print(json.dumps(out))
 
 
+def probe_sync(n_files: int = 120, outage_s: float = 6.0) -> None:
+    """Child mode: the active-active replication story end to end — a
+    paced write storm against filer A with a live ReplicationController
+    mirroring into filer B (steady-state lag sampled from the sync
+    stats), then a full B-side outage under continued writes and the
+    time for the pair to reconverge (full-tree content hash) once B
+    returns. Also checks the `sync` section is exposed in `/_status` on
+    both filers and that the DLQ ends empty. Prints one JSON line."""
+    import hashlib
+    import socket
+    import tempfile
+
+    from seaweedfs_tpu.filer.client import FilerClient
+    from seaweedfs_tpu.replication import ReplicationController, sync_stats
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def tree(url):
+        fc = FilerClient(url)
+        out, stack = {}, ["/sync/"]
+        while stack:
+            d = stack.pop()
+            for e in fc.list(d, limit=10_000):
+                p = d + e["name"]
+                if e.get("is_directory"):
+                    stack.append(p + "/")
+                else:
+                    _, body, _ = fc.get_object(p)
+                    out[p] = hashlib.sha1(body).hexdigest()
+        return out
+
+    def converge(budget_s, poll=0.25):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < budget_s:
+            try:
+                if tree(fa.url) == tree(fb[0].url):
+                    return round(time.perf_counter() - t0, 2)
+            except OSError:
+                pass
+            time.sleep(poll)
+        return None
+
+    out = {"files": n_files, "outage_s": outage_s}
+    with tempfile.TemporaryDirectory() as tmp:
+        servers = []
+
+        def mk(name):
+            ms = MasterServer(host="127.0.0.1", port=free_port()).start()
+            vs = VolumeServer(
+                [os.path.join(tmp, f"vol_{name}")], host="127.0.0.1",
+                port=free_port(), master_url=ms.url, pulse_seconds=0.3,
+                max_volume_count=20,
+            ).start()
+            os.makedirs(os.path.join(tmp, f"vol_{name}"), exist_ok=True)
+            f = FilerServer(
+                host="127.0.0.1", port=free_port(), master_url=ms.url,
+                chunk_size=256 * 1024,
+                db_path=os.path.join(tmp, f"filer_{name}.db"),
+            ).start()
+            servers.extend([ms, vs, f])
+            return ms, vs, f
+
+        ma, va, fa = mk("a")
+        mb, vb, fb_f = mk("b")
+        fb = [fb_f]  # boxed: replaced across the outage restart
+        time.sleep(0.7)
+        ca = FilerClient(fa.url)
+        ctrl = ReplicationController(
+            fa.url, fb[0].url, dlq_dir=tmp, source_path="/sync",
+            poll_interval=0.1,
+        ).start()
+        try:
+            # -- steady state: paced storm, lag sampled mid-flight --------
+            body = os.urandom(2048)
+            lag_samples = []
+            t0 = time.perf_counter()
+            for i in range(n_files):
+                ca.put_object(f"/sync/f{i:04d}.bin", body + str(i).encode())
+                if i % 5 == 4:
+                    lag_samples.append(
+                        sync_stats()["totals"]["max_lag_s"]
+                    )
+                time.sleep(0.01)
+            storm_s = time.perf_counter() - t0
+            steady = converge(60)
+            lag_samples.sort()
+            out["steady"] = {
+                "write_rps": round(n_files / storm_s, 1),
+                "lag_p50_s": lag_samples[len(lag_samples) // 2],
+                "lag_max_s": lag_samples[-1],
+                "converge_after_storm_s": steady,
+            }
+
+            # -- `/_status` exposes the sync section on both filers -------
+            from seaweedfs_tpu.server.http_util import http_json
+
+            out["status_sync_sections"] = {
+                name: sorted(
+                    http_json("GET", f"http://{f.url}/_status")
+                    .get("sync", {}).get("directions", {})
+                )
+                for name, f in (("a", fa), ("b", fb[0]))
+            }
+
+            # -- datacenter loss: B down, writes continue against A -------
+            fb[0].stop()
+            for i in range(n_files // 2):
+                ca.put_object(f"/sync/o{i:04d}.bin", body + b"o%d" % i)
+            time.sleep(outage_s)
+            fb[0] = FilerServer(
+                host="127.0.0.1", port=fb[0].port, master_url=mb.url,
+                chunk_size=256 * 1024,
+                db_path=os.path.join(tmp, "filer_b.db"),
+            ).start()
+            servers.append(fb[0])
+            out["time_to_converge_s"] = converge(120)
+
+            totals = sync_stats()["totals"]
+            out["totals"] = {
+                k: totals[k]
+                for k in ("replicated", "redelivered", "retries",
+                          "parked", "dlq_depth", "stalls")
+            }
+        finally:
+            ctrl.stop()
+            for s in reversed(servers):
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+    print(json.dumps(out))
+
+
 class _NullSink:
     """File-like that discards writes: isolates read+H2D+compute+D2H from
     any filesystem at all (the 'where is the first real bottleneck' probe)."""
@@ -1771,6 +1912,26 @@ def main() -> None:
     except subprocess.TimeoutExpired:
         log("hotshard probe timed out")
 
+    # -- active-active replication: lag, outage recovery, dlq drain ----------
+    sync_bench = None
+    try:
+        r = _run_probe(["--probe-sync", "120", "6"], timeout=420)
+        if r.returncode == 0 and r.stdout.strip():
+            sync_bench = json.loads(r.stdout.strip().splitlines()[-1])
+            log(
+                f"sync: steady lag p50={sync_bench['steady']['lag_p50_s']}s "
+                f"max={sync_bench['steady']['lag_max_s']}s, reconverge "
+                f"after {sync_bench['outage_s']}s outage = "
+                f"{sync_bench['time_to_converge_s']}s, dlq depth after = "
+                f"{sync_bench['totals']['dlq_depth']}, redelivered = "
+                f"{sync_bench['totals']['redelivered']}"
+            )
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            log(f"sync probe failed: {tail[0][:140]}")
+    except subprocess.TimeoutExpired:
+        log("sync probe timed out")
+
     # -- encode probes in fresh subprocesses ----------------------------------
     best, best_cfg, best_raw = 0.0, None, 0.0
     successes = 0
@@ -1995,6 +2156,7 @@ def main() -> None:
                 "filer_pipe": filer_pipe,
                 "serving": serving,
                 "hotshard": hotshard,
+                "sync": sync_bench,
                 "e2e": e2e,
                 "e2e_note": (
                     "all sinks tunnel-bound on this dev host (~100 MB/s "
@@ -2041,6 +2203,9 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 4 and sys.argv[1] == "--probe-serving":
         probe_serving(sys.argv[2], sys.argv[3],
                       int(sys.argv[4]) if len(sys.argv) > 4 else 20000)
+    elif sys.argv[1:2] == ["--probe-sync"]:
+        probe_sync(int(sys.argv[2]) if len(sys.argv) > 2 else 120,
+                   float(sys.argv[3]) if len(sys.argv) > 3 else 6.0)
     elif sys.argv[1:2] == ["--probe-hotshard"]:
         probe_hotshard(
             int(sys.argv[2]) if len(sys.argv) > 2 else 2_000_000,

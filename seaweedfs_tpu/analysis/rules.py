@@ -615,6 +615,84 @@ class BoundedWindow:
         return out
 
 
+class UnboundedRetry:
+    """A ``while True`` loop that performs network I/O and paces itself
+    with a FIXED ``time.sleep(<literal>)`` is an unbounded, non-backing-off
+    retry: when the peer dies, the thread hammers it at a constant rate
+    forever, and on a fleet-wide outage every such loop re-collides in
+    lockstep. The sanctioned forms (util/retry.py) are ``retry_call`` —
+    bounded attempts + jittered exponential backoff — or ``backoff_delays``
+    feeding the sleep for loops that legitimately never exit (peer-follow,
+    sync). Loops gated on an Event (``while not stop.is_set()``) or
+    sleeping a computed/variable delay are not flagged — the bound or the
+    backoff is visible.
+
+    ``util/retry.py`` itself is exempt: it is the primitive the rule tells
+    everyone else to use."""
+
+    name = "unbounded-retry"
+
+    _EXEMPT = ("util/retry.py",)
+
+    _NET_CALLS = {
+        "http_json", "http_bytes", "http_bytes_headers",
+        "http_stream_request", "http_stream_response", "urlopen",
+        "create_connection",
+    }
+
+    def applies_to(self, relpath: str) -> bool:
+        return not any(relpath.endswith(e) for e in self._EXEMPT)
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.While):
+                continue
+            if not self._is_while_true(node):
+                continue
+            net_line = self._first_net_call(node)
+            sleep = self._fixed_sleep(node)
+            if net_line is not None and sleep is not None:
+                out.append(
+                    Violation(
+                        self.name,
+                        relpath,
+                        sleep,
+                        "while-True network loop retries at a fixed "
+                        "interval with no attempt bound or backoff; use "
+                        "util.retry.retry_call, or pace the loop with "
+                        "util.retry.backoff_delays",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _is_while_true(node: ast.While) -> bool:
+        t = node.test
+        return isinstance(t, ast.Constant) and bool(t.value) is True
+
+    def _first_net_call(self, loop: ast.While) -> Optional[int]:
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Call) and _func_name(n) in self._NET_CALLS:
+                return n.lineno
+        return None
+
+    @staticmethod
+    def _fixed_sleep(loop: ast.While) -> Optional[int]:
+        """Line of a ``[time.]sleep(<numeric literal>)`` in the loop body —
+        a constant interval, i.e. visibly no backoff. Variable or computed
+        delays pass (the schedule may grow; proving otherwise is the
+        reviewer's job, not the lint's)."""
+        for n in ast.walk(loop):
+            if not (isinstance(n, ast.Call) and _func_name(n) == "sleep"):
+                continue
+            if n.args and isinstance(n.args[0], ast.Constant) and isinstance(
+                n.args[0].value, (int, float)
+            ):
+                return n.lineno
+        return None
+
+
 RULES = [
     LockDiscipline(),
     Durability(),
@@ -622,4 +700,5 @@ RULES = [
     BroadExcept(),
     ResourceLeak(),
     BoundedWindow(),
+    UnboundedRetry(),
 ]

@@ -42,6 +42,7 @@ from typing import Callable, Optional
 
 from ..util import glog
 from ..util.locks import make_condition, make_lock
+from ..util.retry import RetryPolicy
 
 # encode can stream many GB through the codec; rebuild pulls shards first
 _JOB_TIMEOUT = 600.0
@@ -60,6 +61,13 @@ class EcJob:
     bytes: int = 0
     seconds: float = 0.0
     created: float = field(default_factory=time.monotonic)
+    # retry/preemption bookkeeping: dispatches consumed, members this job
+    # must avoid (they failed or died mid-job), and a monotonic epoch that
+    # fences stale settles — a worker still blocked on a dead member's HTTP
+    # call must not clobber the job after preemption re-queued it elsewhere
+    attempts: int = 0
+    excluded: list = field(default_factory=list)
+    dispatch_epoch: int = 0
 
     @property
     def gbps(self) -> float:
@@ -95,6 +103,8 @@ class EcJobScheduler:
         self,
         locate: Callable[[int], list],
         workers: Optional[int] = None,
+        max_attempts: Optional[int] = None,
+        retry_backoff_s: float = 0.5,
     ):
         self._locate = locate
         self._lock = make_lock("EcJobScheduler._lock")
@@ -106,6 +116,15 @@ class EcJobScheduler:
         self._nworkers = workers or int(
             os.environ.get("SWEED_FLEET_WORKERS", "4")
         )
+        self._max_attempts = max_attempts or int(
+            os.environ.get("SWEED_FLEET_MAX_ATTEMPTS", "3")
+        )
+        self._retry_policy = RetryPolicy(
+            attempts=self._max_attempts, base_s=retry_backoff_s, cap_s=5.0
+        )
+        self._timers: list[threading.Timer] = []
+        self._retries = 0
+        self._preempted = 0
         self._stop = threading.Event()
         self._done = make_condition(self._lock)
         self._next_id = 1
@@ -120,9 +139,34 @@ class EcJobScheduler:
                 self._members[url] = dict(mesh)
 
     def drop_member(self, url: str) -> None:
-        """Reaper/leave hook: a dead node must stop influencing placement."""
+        """Reaper/leave hook: a dead node must stop influencing placement —
+        and jobs RUNNING on it are preempted back to scheduled (attempts
+        permitting) so they retry on a surviving member instead of eating
+        the full dispatch timeout. The worker still blocked on the dead
+        member's socket is fenced out by the dispatch epoch."""
+        requeue: list[int] = []
+        fail: list[tuple[int, int]] = []
         with self._lock:
             self._members.pop(url, None)
+            for job in self._jobs.values():
+                if job.state != "running" or job.server != url:
+                    continue
+                job.excluded.append(url)
+                job.server = ""
+                job.dispatch_epoch += 1
+                self._preempted += 1
+                if job.attempts >= self._max_attempts:
+                    fail.append((job.id, job.dispatch_epoch))
+                else:
+                    job.state = "scheduled"
+                    requeue.append(job.id)
+        for jid in requeue:
+            glog.warning("fleet: preempting job %d off dead member %s",
+                         jid, url)
+            self._queue.put(jid)
+        for jid, epoch in fail:
+            self._settle(jid, epoch=epoch,
+                         error=f"{url} died; attempt cap reached")
 
     def members(self) -> dict[str, dict]:
         with self._lock:
@@ -197,7 +241,9 @@ class EcJobScheduler:
         except Exception as e:  # topology lookup must not kill the job path
             glog.V(1).info("fleet: locate volume %d failed: %s", job.vid, e)
             holders = []
+        excluded = set(job.excluded)
         if job.kind == "encode":
+            holders = [u for u in holders if u not in excluded]
             if not holders:
                 return None
             members = self.members()
@@ -208,6 +254,7 @@ class EcJobScheduler:
         members = self.members()
         candidates = [u for u, m in members.items() if m.get("initialized")] \
             or list(members) or holders
+        candidates = [u for u in candidates if u not in excluded]
         if not candidates:
             return None
         # spread rebuilds round-robin by job id
@@ -219,11 +266,16 @@ class EcJobScheduler:
             if job is None or job.state != "scheduled":
                 return
             job.state = "running"
+            job.attempts += 1
+            epoch = job.dispatch_epoch
         target = self._pick_target(job)
         if target is None:
-            self._settle(jid, error=f"volume {job.vid} has no live holder")
+            self._settle(jid, epoch=epoch,
+                         error=f"volume {job.vid} has no live holder")
             return
         with self._lock:
+            if job.dispatch_epoch != epoch or job.state != "running":
+                return  # preempted while we were choosing a target
             job.server = target
         path = "generate" if job.kind == "encode" else "rebuild"
         from ..server.http_util import http_json
@@ -237,24 +289,59 @@ class EcJobScheduler:
                 timeout=_JOB_TIMEOUT,
             )
         except Exception as e:
-            self._settle(jid, error=f"{target}: {e}")
+            # transport-level failure (member died, refused, timed out):
+            # retry on a DIFFERENT member with backoff, attempts permitting
+            self._retry_or_fail(jid, epoch, f"{target}: {e}")
             return
         if r.get("error"):
-            self._settle(jid, error=f"{target}: {r['error']}")
+            # the member answered: an application error (missing volume,
+            # codec failure) re-breaks identically elsewhere — fail fast
+            self._settle(jid, epoch=epoch, error=f"{target}: {r['error']}")
             return
         self._settle(
             jid,
+            epoch=epoch,
             shards=r.get("shards") or r.get("rebuilt_shards") or [],
             nbytes=int(r.get("bytes", 0)),
             seconds=float(r.get("seconds", 0.0)) or (time.monotonic() - t0),
         )
 
-    def _settle(self, jid: int, error: str = "", shards: Optional[list] = None,
-                nbytes: int = 0, seconds: float = 0.0) -> None:
+    def _retry_or_fail(self, jid: int, epoch: int, error: str) -> None:
+        with self._lock:
+            job = self._jobs.get(jid)
+            if job is None or job.dispatch_epoch != epoch:
+                return  # preemption already re-queued (or settled) this job
+            if job.attempts >= self._max_attempts:
+                pass  # fall through to the terminal settle below
+            else:
+                if job.server:
+                    job.excluded.append(job.server)
+                job.server = ""
+                job.state = "scheduled"
+                job.dispatch_epoch += 1
+                self._retries += 1
+                delay = self._retry_policy.delay(job.attempts - 1)
+                t = threading.Timer(delay, self._queue.put, args=(jid,))
+                t.daemon = True
+                self._timers.append(t)
+                self._timers = [x for x in self._timers if x.is_alive()]
+                glog.warning(
+                    "fleet job %d attempt %d failed (%s); retrying on "
+                    "another member in %.2fs", jid, job.attempts, error, delay)
+                t.start()
+                return
+        self._settle(jid, epoch=epoch,
+                     error=f"{error} (attempt cap {self._max_attempts})")
+
+    def _settle(self, jid: int, epoch: Optional[int] = None, error: str = "",
+                shards: Optional[list] = None, nbytes: int = 0,
+                seconds: float = 0.0) -> None:
         with self._lock:
             job = self._jobs.get(jid)
             if job is None:
                 return
+            if epoch is not None and job.dispatch_epoch != epoch:
+                return  # stale: the job moved on (preempted/re-queued)
             job.state = "failed" if error else "done"
             job.error = error
             job.shards = shards or []
@@ -297,6 +384,8 @@ class EcJobScheduler:
                 "jobs_running": by_state["running"] + by_state["scheduled"],
                 "jobs_done": by_state["done"],
                 "jobs_failed": by_state["failed"],
+                "jobs_retried": self._retries,
+                "jobs_preempted": self._preempted,
                 "jobs": [self._jobs[j].info() for j in tail],
             }
 
@@ -304,6 +393,9 @@ class EcJobScheduler:
         self._stop.set()
         with self._lock:
             threads = list(self._threads)
+            timers = list(self._timers)
+        for t in timers:
+            t.cancel()
         for t in threads:
             t.join(timeout=2.0)
         _unregister(self)
@@ -334,11 +426,12 @@ def fleet_stats() -> dict:
         active = list(_ACTIVE)
     agg = {"schedulers": len(active), "members": 0, "jobs_scheduled": 0,
            "jobs_running": 0, "jobs_done": 0, "jobs_failed": 0,
-           "member_gbps": {}}
+           "jobs_retried": 0, "jobs_preempted": 0, "member_gbps": {}}
     for s in active:
         st = s.stats(jobs_tail=0)
         agg["members"] += len(st["members"])
-        for k in ("jobs_scheduled", "jobs_running", "jobs_done", "jobs_failed"):
+        for k in ("jobs_scheduled", "jobs_running", "jobs_done", "jobs_failed",
+                  "jobs_retried", "jobs_preempted"):
             agg[k] += st[k]
         for u, ms in st["member_stats"].items():
             agg["member_gbps"][u] = ms.get("gbps", 0.0)

@@ -18,11 +18,35 @@ from ..server.http_util import (
     http_stream_request,
     http_stream_response,
 )
+from ..util.retry import READ_POLICY, RetryError, retry_call
+
+
+class FilerHTTPError(IOError):
+    """Non-2xx from the filer, with the status attached so retry
+    classifiers can split transient (5xx/429) from poison (4xx) without
+    parsing the message string."""
+
+    def __init__(self, op: str, path: str, status: int, body: bytes = b""):
+        super().__init__(f"{op} {path}: HTTP {status} {body[:200]!r}")
+        self.status = status
 
 
 class FilerClient:
-    def __init__(self, filer_url: str):
+    def __init__(self, filer_url: str, retry_reads: bool = True):
         self.base = f"http://{filer_url}"
+        # idempotent reads ride the shared bounded-retry helper so a
+        # connection reset mid-failover doesn't surface as a user error;
+        # writes are NOT retried here — their callers (replication, s3
+        # gateway) own retry policy and double-retrying multiplies load
+        self._read_policy = READ_POLICY if retry_reads else None
+
+    def _read(self, fn, *args, **kwargs):
+        if self._read_policy is None:
+            return fn(*args, **kwargs)
+        try:
+            return retry_call(fn, *args, policy=self._read_policy, **kwargs)
+        except RetryError as e:
+            raise e.last  # callers keep seeing the original URLError/OSError
 
     def _u(self, path: str, **q) -> str:
         qs = urllib.parse.urlencode({k: v for k, v in q.items() if v != ""})
@@ -54,7 +78,7 @@ class FilerClient:
             headers=headers,
         )
         if status >= 300:
-            raise IOError(f"PUT {path}: HTTP {status} {data[:200]!r}")
+            raise FilerHTTPError("PUT", path, status, data)
         return json.loads(data)
 
     def put_object_stream(
@@ -95,7 +119,7 @@ class FilerClient:
             headers=headers, timeout=600,
         )
         if status >= 300:
-            raise IOError(f"PUT {path}: HTTP {status} {data[:200]!r}")
+            raise FilerHTTPError("PUT", path, status, data)
         return json.loads(data)
 
     def get_object_stream(
@@ -114,8 +138,8 @@ class FilerClient:
     def get_object(
         self, path: str, rng: Optional[str] = None
     ) -> tuple[int, bytes, dict]:
-        return http_bytes_headers(
-            "GET", self._u(path),
+        return self._read(
+            http_bytes_headers, "GET", self._u(path),
             headers={"Range": rng} if rng else None, timeout=60,
         )
 
@@ -143,7 +167,7 @@ class FilerClient:
 
     # -- entry level ----------------------------------------------------------
     def get_entry(self, path: str) -> Optional[dict]:
-        status, body = http_bytes("GET", self._u(path, meta="true"))
+        status, body = self._read(http_bytes, "GET", self._u(path, meta="true"))
         if status != 200:
             return None
         return json.loads(body)
@@ -159,8 +183,14 @@ class FilerClient:
             body=entry,
         )
 
-    def mkdir(self, path: str) -> None:
-        http_json("POST", self._u(path.rstrip("/") + "/", mkdir="true"))
+    def mkdir(self, path: str, signatures: Optional[list[int]] = None) -> None:
+        http_json(
+            "POST",
+            self._u(
+                path.rstrip("/") + "/", mkdir="true",
+                sig=",".join(map(str, signatures or [])),
+            ),
+        )
 
     def delete(
         self,
@@ -188,7 +218,8 @@ class FilerClient:
         limit: int = 1000,
         prefix: str = "",
     ) -> list[dict]:
-        status, body = http_bytes(
+        status, body = self._read(
+            http_bytes,
             "GET",
             self._u(
                 dir_path.rstrip("/") + "/",
@@ -216,10 +247,11 @@ class FilerClient:
 
     # -- meta subscribe / kv / status ----------------------------------------
     def status(self) -> dict:
-        return http_json("GET", self.base + "/_status")
+        return self._read(http_json, "GET", self.base + "/_status")
 
     def meta_events(self, since_ns: int = 0, limit: int = 1000) -> dict:
-        return http_json(
+        return self._read(
+            http_json,
             "GET",
             self.base + f"/_meta/events?since_ns={since_ns}&limit={limit}",
         )
@@ -228,8 +260,8 @@ class FilerClient:
         http_bytes("PUT", self.base + "/_kv/" + urllib.parse.quote(key), value)
 
     def kv_get(self, key: str) -> Optional[bytes]:
-        status, body = http_bytes(
-            "GET", self.base + "/_kv/" + urllib.parse.quote(key)
+        status, body = self._read(
+            http_bytes, "GET", self.base + "/_kv/" + urllib.parse.quote(key)
         )
         return body if status == 200 else None
 

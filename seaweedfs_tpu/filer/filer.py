@@ -84,6 +84,12 @@ class Filer:
                     raise FileExistsError(entry.full_path)
                 if old.is_directory and not entry.is_directory:
                     raise IsADirectoryError(entry.full_path)
+                if old.is_directory and entry.is_directory:
+                    # re-mkdir is a no-op and emits NO meta event: a
+                    # replicated mkdir would otherwise echo between
+                    # active-active clusters forever — each apply raising
+                    # a fresh event the other side re-applies
+                    return old
             if old is not None and old.hard_link_id and not entry.hard_link_id:
                 # writing through a linked path updates the shared inode so
                 # every link sees the new content (filerstore_hardlink.go)

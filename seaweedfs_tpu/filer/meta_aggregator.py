@@ -20,12 +20,18 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..util.retry import RetryPolicy, backoff_delays
 from .entry import Entry
 from .filerstore import FilerStore, NotFoundError
 from .meta_log import EventNotification, MetaLog
 
 PEER_SIG_PREFIX = b"filer.peer.sig."
 OFFSET_PREFIX = b"meta_agg.offset."
+
+# paces re-polls of an unreachable (or stalling) peer; the follow loop
+# itself never gives up — a filer peer being down is a normal state
+_FOLLOW_BACKOFF = RetryPolicy(attempts=6, base_s=0.2, cap_s=5.0,
+                              deadline_s=1e9, jitter=False)
 
 
 def apply_event_to_store(store: FilerStore, ev: EventNotification) -> None:
@@ -112,7 +118,7 @@ class MetaAggregator:
         store = self.filer.store
         shares_store: Optional[bool] = None
         since = int(store.kv_get(self._offset_key(peer)) or 0)
-        backoff = 0.2
+        delays = None  # lazily-made backoff_delays generator; None = healthy
         apply_failures: dict[int, int] = {}  # peer seq -> consecutive failures
         while not self._stop.is_set():
             try:
@@ -127,12 +133,13 @@ class MetaAggregator:
                     f"&wait_s={self.poll_wait_s}&limit=500",
                     timeout=self.poll_wait_s + 10,
                 )
-                backoff = 0.2
+                delays = None  # a successful poll resets the schedule
             except Exception:
                 shares_store = None  # peer may have restarted with a new store
-                if self._stop.wait(backoff):
+                if delays is None:
+                    delays = backoff_delays(_FOLLOW_BACKOFF)
+                if self._stop.wait(next(delays, _FOLLOW_BACKOFF.cap_s)):
                     return
-                backoff = min(backoff * 2, 5.0)
                 continue
             oldest = int(r.get("oldest_ts_ns", 0))
             if since and oldest > since:
@@ -173,6 +180,7 @@ class MetaAggregator:
             if applied_any:
                 store.kv_put(self._offset_key(peer), str(since).encode())
             if stalled:
-                if self._stop.wait(backoff):
+                if delays is None:
+                    delays = backoff_delays(_FOLLOW_BACKOFF)
+                if self._stop.wait(next(delays, _FOLLOW_BACKOFF.cap_s)):
                     return
-                backoff = min(backoff * 2, 5.0)

@@ -9,14 +9,23 @@
 - `notification`: pluggable queues publishing filer meta events — memory,
   JSONL file, glog, webhook, native-SigV4 SQS, gated kafka/pubsub.
 - `filer_sync`: continuous active-active or active-passive sync between two
-  filer clusters with signature-based loop prevention and offsets
-  checkpointed in the target filer's KV store.
+  filer clusters with signature-based loop prevention, crash-idempotent
+  apply (KV markers + batch offset checkpoints), LWW conflict resolution,
+  and bounded per-event retry.
+- `controller`: `ReplicationController` owning both directions of an
+  active-active pair, with per-direction dead-letter queues and the
+  `sync_stats()` snapshot behind the `sweed_sync_*` gauges.
 """
 
 from .replicator import Replicator  # noqa: F401
 from .sink import FilerSink, LocalFsSink, S3Sink  # noqa: F401
 from .cloud_sinks import AzureSink, B2Sink, GcsSink, make_sink  # noqa: F401
-from .filer_sync import FilerSync  # noqa: F401
+from .filer_sync import FilerSync, SyncStalled  # noqa: F401
+from .controller import (  # noqa: F401
+    DeadLetterQueue,
+    ReplicationController,
+    sync_stats,
+)
 from .notification import (  # noqa: F401
     FileQueue,
     LogQueue,

@@ -17,6 +17,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
+import os
 import queue
 import threading
 import urllib.error
@@ -25,7 +26,7 @@ import urllib.request
 from datetime import datetime, timezone
 from typing import Optional
 
-from ..util import glog
+from ..util import faultpoints, glog
 
 
 class MessageQueue:
@@ -34,11 +35,26 @@ class MessageQueue:
 
 
 class MemoryQueue(MessageQueue):
+    """In-process hand-off. Overflow drops the OLDEST entry (counted in
+    ``dropped``) rather than blocking the sender — the bus calls ``send``
+    from its drain thread, and a full queue must never wedge it behind a
+    consumer that went away."""
+
     def __init__(self, maxsize: int = 10000):
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.dropped = 0
 
     def send(self, key, message):
-        self.q.put((key, message))
+        while True:
+            try:
+                self.q.put_nowait((key, message))
+                return
+            except queue.Full:
+                try:
+                    self.q.get_nowait()
+                    self.dropped += 1
+                except queue.Empty:
+                    pass  # racing consumer freed space; retry the put
 
     def receive(self, timeout: float = 1.0) -> Optional[tuple[str, dict]]:
         try:
@@ -48,23 +64,46 @@ class MemoryQueue(MessageQueue):
 
 
 class FileQueue(MessageQueue):
-    """Append-only JSONL event log."""
+    """Append-only JSONL event log, crash-durable: each append is flushed
+    and fsynced before ``send`` returns, and ``read_all`` tolerates a torn
+    trailing line (a kill mid-append leaves a partial record; it is the
+    only line allowed to be garbage, counted in ``torn_lines``)."""
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
+        self.torn_lines = 0
 
     def send(self, key, message):
         line = json.dumps({"key": key, "message": message})
         with self._lock, open(self.path, "a") as f:
             f.write(line + "\n")
+            f.flush()
+            # torn-write faults truncate mid-record here, modeling power
+            # loss between the buffered append and its fsync
+            faultpoints.fire("notify.file.append", path=self.path)
+            os.fsync(f.fileno())
 
     def read_all(self) -> list[dict]:
         try:
             with open(self.path) as f:
-                return [json.loads(ln) for ln in f if ln.strip()]
+                raw = [ln for ln in f if ln.strip()]
         except FileNotFoundError:
             return []
+        out: list[dict] = []
+        for i, ln in enumerate(raw):
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                if i == len(raw) - 1:
+                    self.torn_lines += 1
+                    glog.warning(
+                        "%s: skipping torn trailing line (%d bytes)",
+                        self.path, len(ln),
+                    )
+                else:
+                    raise  # mid-file corruption is NOT a crash artifact
+        return out
 
 
 class LogQueue(MessageQueue):

@@ -12,6 +12,7 @@ import os
 from typing import Optional
 
 from ..filer.client import FilerClient
+from ..util import faultpoints
 
 
 class ReplicationSink:
@@ -43,25 +44,33 @@ class FilerSink(ReplicationSink):
         self.client = FilerClient(filer_url)
         self.prefix = path_prefix.rstrip("/")
         self.signatures = signatures or []
+        # extra extended attrs stamped onto every write — the sync loop sets
+        # this per-event to `Repl-Ts`/`Repl-Src` so the target records the
+        # ORIGIN write's identity (its LWW tiebreak key), not the apply time
+        self.stamp: dict[str, str] = {}
 
     def _path(self, key: str) -> str:
         return self.prefix + key if self.prefix else key
 
     def create_entry(self, key, entry, data):
+        faultpoints.fire("repl.sink.write")
         if entry.get("is_directory"):
-            self.client.mkdir(self._path(key))
+            self.client.mkdir(self._path(key), signatures=self.signatures)
             return
+        extended = {
+            k: v for k, v in entry.get("extended", {}).items() if k != "md5"
+        }
+        extended.update(self.stamp)
         self.client.put_object(
             self._path(key),
             data or b"",
             content_type=entry.get("mime", ""),
-            extended={
-                k: v for k, v in entry.get("extended", {}).items() if k != "md5"
-            },
+            extended=extended,
             signatures=self.signatures,
         )
 
     def delete_entry(self, key, is_directory):
+        faultpoints.fire("repl.sink.delete")
         self.client.delete(
             self._path(key), recursive=is_directory, signatures=self.signatures
         )

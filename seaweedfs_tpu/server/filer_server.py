@@ -350,8 +350,19 @@ class FilerServer:
         self._lookup = _VidLookup(self._master_client)
         self._load_filer_conf()
         self._srv = None
-        # cluster-sync loop-prevention signature (filer.go Signature)
-        self.signature = random.getrandbits(31)
+        # cluster-sync loop-prevention signature (filer.go Signature),
+        # PERSISTED in the store: a restarted cluster must keep the
+        # signature its replicated writes already carry on the peer, or the
+        # peer's reverse syncer stops recognizing them and echoes old
+        # events back after a datacenter bounce
+        sig_raw = self.filer.store.kv_get(b"filer.signature")
+        if sig_raw:
+            self.signature = int(sig_raw)
+        else:
+            self.signature = random.getrandbits(31)
+            self.filer.store.kv_put(
+                b"filer.signature", str(self.signature).encode()
+            )
         # register our signature in the store so peers sharing it can tell
         # (meta_aggregator.go:43 store-sharing detection)
         from ..filer.meta_aggregator import PEER_SIG_PREFIX, MetaAggregator
@@ -505,6 +516,12 @@ class FilerServer:
             f"seaweedfs_tpu filer {self.url}", {"Filer": status}
         )
 
+    @staticmethod
+    def _sync_stats_safe() -> dict:
+        from ..replication.controller import sync_stats
+
+        return sync_stats()
+
     def _h_status(self, h, path, q, body):
         return 200, {
             "signature": self.signature,
@@ -528,6 +545,9 @@ class FilerServer:
             # serving-core counters: mode, inflight connections,
             # admission shedding, loop lag, coalesced-assign batch shape
             "serving": serving_stats(),
+            # cross-cluster replication: per-direction lag/inflight/dlq
+            # (network-free snapshot — readable while the peer is down)
+            "sync": self._sync_stats_safe(),
         }
 
     def _h_metrics(self, h, path, q, body):
@@ -814,7 +834,7 @@ class FilerServer:
                 entry = Entry(
                     full_path=path.rstrip("/") or "/", is_directory=True, mode=0o775
                 )
-                self.filer.create_entry(entry)
+                self.filer.create_entry(entry, signatures=self._sigs(q))
                 return 201, {"name": entry.name}
             return 400, {"error": "cannot write to a directory path"}
         # every meta_shaped condition returned above; file bodies go through

@@ -1262,3 +1262,44 @@ def bucket_delete(env: CommandEnv, name: str) -> dict:
     if status not in (200, 204):
         raise RuntimeError(f"delete bucket {name}: http {status}")
     return {"deleted": name}
+
+
+def remote_dlq(
+    env: CommandEnv, dlq_dir: str, replay: bool = False, direction: str = ""
+) -> dict:
+    """Inspect or replay the replication dead-letter queues under
+    ``dlq_dir`` (one ``dlq.<direction>.jsonl`` per sync direction, written
+    by ReplicationController). List mode is read-only; ``-replay``
+    re-applies each parked event to its recorded target — records that
+    fail again stay parked."""
+    import os
+
+    from ..replication.controller import DeadLetterQueue
+
+    if not dlq_dir:
+        raise RuntimeError("remote.dlq needs -dir=DLQ_DIR")
+    out: dict = {}
+    for fname in sorted(os.listdir(dlq_dir)):
+        if not (fname.startswith("dlq.") and fname.endswith(".jsonl")):
+            continue
+        name = fname[len("dlq."):-len(".jsonl")]
+        if direction and name != direction:
+            continue
+        dlq = DeadLetterQueue(os.path.join(dlq_dir, fname))
+        if replay:
+            out[name] = dlq.replay()
+        else:
+            out[name] = {
+                "depth": dlq.depth(),
+                "entries": [
+                    {
+                        "path": r.get("path"),
+                        "ts_ns": r.get("ts_ns"),
+                        "target": r.get("target"),
+                        "error": r.get("error"),
+                        "parked_unix": r.get("parked_unix"),
+                    }
+                    for r in dlq.entries()
+                ],
+            }
+    return out

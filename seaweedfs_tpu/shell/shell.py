@@ -42,6 +42,8 @@ HELP = """commands:
                 -ttl=T -apply=true|-delete=true]
   bucket.list | bucket.create -name=B | bucket.delete -name=B
   query -path=FILE [-input=csv|json] 'SELECT ... FROM s3object [WHERE ...]'
+  remote.dlq -dir=DLQ_DIR [-direction=a_to_b] [-replay]
+                 list (or -replay) events parked by cross-cluster sync
   lock | unlock
   help | exit
 """
@@ -98,7 +100,15 @@ def run_command_with_failover(env: CommandEnv, line: str) -> object:
         if not env.re_resolve_master():
             raise
         if cmd in _RETRY_SAFE:
-            return run_command(env, line)
+            # shared bounded-backoff re-run: the freshly-resolved master may
+            # still be settling (leader election, warm-up), so a single
+            # immediate retry under-delivers — pace a few attempts instead
+            from ..util.retry import READ_POLICY, RetryError, retry_call
+
+            try:
+                return retry_call(run_command, env, line, policy=READ_POLICY)
+            except RetryError as e2:
+                raise e2.last from e
         raise RuntimeError(
             f"{e} — master failed over to {env.master}; the command may "
             f"have partially executed, re-run it deliberately"
@@ -245,6 +255,13 @@ def run_command(env: CommandEnv, line: str) -> object:
             args[0] if args else "",
             flags.get("path", ""),
             flags.get("input", "csv"),
+        )
+    if cmd == "remote.dlq":
+        return C.remote_dlq(
+            env,
+            flags.get("dir", ""),
+            replay=flags.get("replay") == "true",
+            direction=flags.get("direction", ""),
         )
     if cmd == "lock":
         return env.lock()

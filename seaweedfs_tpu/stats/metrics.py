@@ -396,6 +396,14 @@ def register_fleet_metrics(registry: Optional[Registry] = None) -> None:
         "sweed_fleet_jobs_failed_total",
         "EC jobs that errored (member death, missing volume, ...)",
     ).set_function(lambda: _snap("jobs_failed"))
+    reg.gauge(
+        "sweed_fleet_retries_total",
+        "EC job dispatches re-queued onto a different member",
+    ).set_function(lambda: _snap("jobs_retried"))
+    reg.gauge(
+        "sweed_fleet_preempted_total",
+        "running EC jobs pulled back because their member went dark",
+    ).set_function(lambda: _snap("jobs_preempted"))
 
     gbps = reg.gauge(
         "sweed_fleet_member_encode_gbps",
@@ -419,6 +427,69 @@ def register_fleet_metrics(registry: Optional[Registry] = None) -> None:
 
 
 register_fleet_metrics()
+
+
+def register_sync_metrics(registry: Optional[Registry] = None) -> None:
+    """Gauges over live cross-cluster sync directions
+    (replication/controller.py sync_stats): per-direction lag plus
+    process-wide totals. The snapshot is network-free by construction —
+    these gauges must stay readable while the PEER cluster is down."""
+
+    def _tot(key):
+        from ..replication.controller import sync_stats
+
+        return sync_stats()["totals"].get(key, 0)
+
+    reg = registry if registry is not None else default_registry
+    reg.gauge(
+        "sweed_sync_replicated_total",
+        "meta events applied to a peer cluster",
+    ).set_function(lambda: _tot("replicated"))
+    reg.gauge(
+        "sweed_sync_redelivered_total",
+        "crash-window redeliveries proven no-ops by idempotence markers",
+    ).set_function(lambda: _tot("redelivered"))
+    reg.gauge(
+        "sweed_sync_lww_skipped_total",
+        "conflicting writes dropped as the last-writer-wins loser",
+    ).set_function(lambda: _tot("lww_skipped"))
+    reg.gauge(
+        "sweed_sync_retries_total",
+        "transient per-event apply retries",
+    ).set_function(lambda: _tot("retries"))
+    reg.gauge(
+        "sweed_sync_inflight",
+        "events fetched but not yet applied, summed over directions",
+    ).set_function(lambda: _tot("inflight"))
+    reg.gauge(
+        "sweed_sync_dlq_depth",
+        "poison events parked awaiting remote.dlq replay",
+    ).set_function(lambda: _tot("dlq_depth"))
+    reg.gauge(
+        "sweed_sync_parked_total",
+        "events classified poison and parked to the dead-letter queue",
+    ).set_function(lambda: _tot("parked"))
+
+    lag = reg.gauge(
+        "sweed_sync_lag_s",
+        "replication lag per direction (last seen source ts - checkpoint)",
+    )
+
+    def _push_lag():
+        from ..replication.controller import sync_stats
+
+        snap = sync_stats()
+        for name, d in snap["directions"].items():
+            lag.set(d.get("lag_s", 0.0), direction=name)
+        return snap["totals"].get("max_lag_s", 0.0)
+
+    reg.gauge(
+        "sweed_sync_max_lag_s",
+        "worst-direction replication lag",
+    ).set_function(_push_lag)
+
+
+register_sync_metrics()
 
 
 def register_scrub_metrics(

@@ -378,29 +378,37 @@ class Store:
         range is unobtainable remotely."""
         if self.remote_shard_reader is None:
             return None
-        import time
+        from ..util.retry import TRANSIENT, RetryError, RetryPolicy, retry_call
 
-        deadline = time.monotonic() + self.remote_fetch_timeout_s
-        backoff = self.remote_fetch_backoff_s
-        for attempt in range(max(1, self.remote_fetch_attempts)):
-            try:
-                faultpoints.fire("ec.read.remote-fetch")
-                data = self.remote_shard_reader(vid, sid, offset, size)
-                if data is not None and len(data) == size:
-                    return data
+        def _fetch():
+            faultpoints.fire("ec.read.remote-fetch")
+            data = self.remote_shard_reader(vid, sid, offset, size)
+            if data is None or len(data) != size:
                 # a short range is a failed attempt, not a success
-                data = None
-            except Exception as e:  # peer down / timeout / injected fault
-                glog.warning(
+                raise IOError(f"short/empty remote range for {vid}.{sid}")
+            return data
+
+        policy = RetryPolicy(
+            attempts=max(1, self.remote_fetch_attempts),
+            base_s=self.remote_fetch_backoff_s,
+            cap_s=max(1.0, self.remote_fetch_backoff_s * 8),
+            deadline_s=self.remote_fetch_timeout_s,
+        )
+        try:
+            return retry_call(
+                _fetch,
+                policy=policy,
+                # every failure mode here (peer down, timeout, short read,
+                # injected fault) heals the same way: try again, then fall
+                # through to reconstruction — nothing is poison
+                classify=lambda e: TRANSIENT,
+                on_retry=lambda e, attempt, delay: glog.warning(
                     "remote shard %d.%d fetch attempt %d failed: %s",
-                    vid, sid, attempt + 1, e,
-                )
-            now = time.monotonic()
-            if attempt + 1 >= self.remote_fetch_attempts or now + backoff > deadline:
-                return None
-            time.sleep(backoff)
-            backoff = min(backoff * 2, max(0.0, deadline - time.monotonic()))
-        return None
+                    vid, sid, attempt, e,
+                ),
+            )
+        except RetryError:
+            return None
 
     def _recover_interval(
         self, ev: EcVolume, missing_shard: int, offset: int, size: int

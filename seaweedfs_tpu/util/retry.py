@@ -1,0 +1,159 @@
+"""Shared bounded retry/backoff: ONE policy object for every network loop.
+
+Before this module each layer grew its own retry shape — the degraded-read
+remote shard fetch hand-rolled exponential backoff with a deadline
+(`storage/store.py`), the meta aggregator doubled a local ``backoff``
+variable, the shell retried reads after master failover, and the
+replication loop had nothing at all. Uniformity is the point: a retry loop
+you can't configure is a retry loop you can't test, and an UNbounded one is
+a thread leak waiting for a dead peer (the ``unbounded-retry`` sweedlint
+rule flags ad-hoc forms; these helpers are the sanctioned one).
+
+Three layers, smallest first:
+
+``backoff_delays(policy)``
+    Generator of sleep durations — exponential with full jitter, capped,
+    bounded by ``attempts``. For code that owns its own loop (the meta
+    aggregator's poll loop wants to keep polling forever but *pace* by
+    this schedule; it resets by making a fresh generator).
+
+``retry_call(fn, policy=..., classify=...)``
+    Run ``fn`` until it returns, a classifier says the error is permanent
+    (poison), attempts exhaust, or the deadline passes. Honors
+    ``Retry-After`` when the raised error carries ``retry_after``.
+
+``classify_error(exc)``
+    The default transient/poison split: connection-level OSErrors, DNS
+    failures, timeouts, and HTTP 5xx/429 are ``TRANSIENT`` (the peer may
+    heal); HTTP 4xx and programming errors are ``POISON`` (retrying
+    re-breaks identically — park it, don't hammer).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import urllib.error
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional
+
+TRANSIENT = "transient"
+POISON = "poison"
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with full jitter.
+
+    ``attempts`` counts CALLS, not sleeps: attempts=3 means at most three
+    tries separated by at most two sleeps. ``deadline_s`` bounds the whole
+    affair in wall time — whichever limit lands first wins."""
+
+    attempts: int = 3
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    deadline_s: float = 30.0
+    jitter: bool = True
+
+    def delay(self, attempt: int) -> float:
+        """Sleep before try ``attempt+1`` (attempt is 0-based)."""
+        d = min(self.cap_s, self.base_s * (2 ** attempt))
+        if self.jitter:
+            # full jitter (AWS architecture blog): uncorrelated retriers
+            # don't re-collide on the very peer that just shed them
+            d = random.uniform(0, d)
+        return d
+
+
+#: replication apply path: a dead target cluster is normal (datacenter
+#: loss), so keep individual applies snappy and let the outer loop pace
+REPLICATION_POLICY = RetryPolicy(attempts=3, base_s=0.05, cap_s=1.0,
+                                 deadline_s=15.0)
+
+#: interactive/read paths (shell query, filer client reads)
+READ_POLICY = RetryPolicy(attempts=3, base_s=0.05, cap_s=0.5, deadline_s=10.0)
+
+
+class RetryError(Exception):
+    """Raised by retry_call when attempts/deadline exhaust. ``last`` is the
+    final underlying error; ``permanent`` is True when a classifier called
+    it poison (callers route those to a dead-letter path, not more retry)."""
+
+    def __init__(self, last: BaseException, attempts: int,
+                 permanent: bool = False):
+        super().__init__(
+            f"{'permanent' if permanent else 'exhausted'} after "
+            f"{attempts} attempt(s): {last}"
+        )
+        self.last = last
+        self.attempts = attempts
+        self.permanent = permanent
+
+
+def classify_error(exc: BaseException) -> str:
+    """Default transient/poison classifier (see module docstring)."""
+    status = getattr(exc, "status", None)
+    if status is None and isinstance(exc, urllib.error.HTTPError):
+        status = exc.code
+    if status is not None:
+        if status == 429 or status >= 500:
+            return TRANSIENT
+        if 400 <= status < 500:
+            return POISON
+    if isinstance(exc, (ConnectionError, TimeoutError, urllib.error.URLError,
+                        OSError)):
+        # the whole OSError family the HTTP layer raises is connection
+        # level: refused/reset/unreachable/DNS/timeouts/EIO fault points
+        return TRANSIENT
+    return POISON
+
+
+def backoff_delays(policy: RetryPolicy) -> Iterator[float]:
+    """The sleep schedule between attempts: yields ``attempts - 1`` delays
+    (a generator per burst; make a fresh one to reset after success)."""
+    for attempt in range(max(0, policy.attempts - 1)):
+        yield policy.delay(attempt)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: RetryPolicy = RetryPolicy(),
+    classify: Callable[[BaseException], str] = classify_error,
+    on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)`` with bounded retry.
+
+    Raises :class:`RetryError` when the classifier says POISON
+    (``permanent=True``, no further tries) or when attempts/deadline
+    exhaust on TRANSIENT errors. A ``retry_after`` attribute on the raised
+    error (seconds, e.g. parsed from an HTTP 503's ``Retry-After`` header)
+    overrides the computed backoff for that step — the peer told us when
+    to come back; guessing earlier just re-sheds."""
+    deadline = time.monotonic() + policy.deadline_s
+    attempts = max(1, policy.attempts)
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — classifier decides
+            last = e
+            if classify(e) == POISON:
+                raise RetryError(e, attempt + 1, permanent=True) from e
+            if attempt + 1 >= attempts:
+                break
+            d = policy.delay(attempt)
+            ra = getattr(e, "retry_after", None)
+            if ra is not None:
+                try:
+                    d = max(d, float(ra))
+                except (TypeError, ValueError):
+                    pass
+            if time.monotonic() + d > deadline:
+                break
+            if on_retry is not None:
+                on_retry(e, attempt + 1, d)
+            sleep(d)
+    raise RetryError(last, min(attempt + 1, attempts)) from last

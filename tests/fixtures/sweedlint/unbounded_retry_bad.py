@@ -1,0 +1,14 @@
+"""Fixture: fixed-interval while-True network retry — unbounded-retry
+must fire exactly once."""
+
+import time
+
+from seaweedfs_tpu.server.http_util import http_json
+
+
+def fetch_forever(url):
+    while True:
+        try:
+            return http_json("GET", url)
+        except OSError:
+            time.sleep(0.5)

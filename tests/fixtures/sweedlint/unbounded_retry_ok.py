@@ -1,0 +1,20 @@
+"""Fixture: a paced poll loop with a waived fixed sleep, plus the
+sanctioned bounded form — sweedlint must report nothing."""
+
+import time
+
+from seaweedfs_tpu.server.http_util import http_json
+from seaweedfs_tpu.util.retry import READ_POLICY, retry_call
+
+
+def fetch_with_policy(url):
+    return retry_call(http_json, "GET", url, policy=READ_POLICY)
+
+
+def poll_forever(url):
+    while True:
+        try:
+            return http_json("GET", url)
+        except OSError:
+            # sweedlint: ok unbounded-retry heartbeat pacing; the reaper bounds how long the peer stays listed
+            time.sleep(0.5)

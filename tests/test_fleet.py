@@ -79,6 +79,128 @@ def test_scheduler_bad_kind_rejected():
         sched.stop()
 
 
+# ------------------------------------------- retry / preemption semantics
+def _stub_member(response=None, delay=0.0):
+    """A fake volume server answering /admin/ec/* with a canned JSON body;
+    returns (url, calls, shutdown)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    calls = []
+    resp = response or {"shards": list(range(TOTAL_SHARDS)),
+                        "bytes": 1000, "seconds": 0.5}
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            calls.append(self.path)
+            if delay:
+                time.sleep(delay)
+            body = json.dumps(resp).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return f"127.0.0.1:{srv.server_port}", calls, srv.shutdown
+
+
+def test_scheduler_retries_transport_failure_on_another_member():
+    """A dead first holder (connection refused) costs one bounded-backoff
+    retry, excluded from re-pick; the job completes on the live replica and
+    the retry lands in the counters and gauges."""
+    dead = f"127.0.0.1:{free_port()}"  # nothing listening: instant refusal
+    live, calls, shutdown = _stub_member()
+    sched = EcJobScheduler(
+        locate=lambda vid: [dead, live], workers=1,
+        max_attempts=3, retry_backoff_s=0.01,
+    )
+    try:
+        jid = sched.submit("encode", 7)
+        assert sched.wait([jid], timeout=30)
+        job = sched.job_info(jid)
+        assert job["state"] == "done", job
+        assert job["server"] == live
+        assert job["shards"] == list(range(TOTAL_SHARDS))
+        assert calls, "live member never saw the retried dispatch"
+        st = sched.stats()
+        assert st["jobs_retried"] == 1 and st["jobs_preempted"] == 0
+        from seaweedfs_tpu.stats.metrics import default_registry
+
+        text = default_registry.expose()
+        assert "sweed_fleet_retries_total" in text
+        assert "sweed_fleet_preempted_total" in text
+    finally:
+        shutdown()
+        sched.stop()
+
+
+def test_scheduler_attempt_cap_is_terminal():
+    """All replicas dead: the job burns its attempt budget (one member
+    excluded per try) and fails with the cap named — never an unbounded
+    dispatch loop."""
+    deads = [f"127.0.0.1:{free_port()}" for _ in range(3)]
+    sched = EcJobScheduler(
+        locate=lambda vid: list(deads), workers=1,
+        max_attempts=2, retry_backoff_s=0.01,
+    )
+    try:
+        jid = sched.submit("encode", 9)
+        assert sched.wait([jid], timeout=30)
+        job = sched.job_info(jid)
+        assert job["state"] == "failed", job
+        assert "attempt cap 2" in job["error"], job
+        st = sched.stats()
+        assert st["jobs_retried"] == 1  # attempt 1 retried, attempt 2 terminal
+    finally:
+        sched.stop()
+
+
+def test_scheduler_preempts_job_off_dropped_member():
+    """drop_member mid-job re-queues the running job onto a survivor; the
+    worker still blocked on the dead member's socket is fenced by the
+    dispatch epoch when its stale response finally lands."""
+    slow_resp = {"shards": [99], "bytes": 1, "seconds": 9.9}
+    slow, slow_calls, slow_down = _stub_member(response=slow_resp, delay=3.0)
+    fast, fast_calls, fast_down = _stub_member()
+    sched = EcJobScheduler(
+        locate=lambda vid: [slow, fast], workers=2,
+        max_attempts=3, retry_backoff_s=0.01,
+    )
+    try:
+        jid = sched.submit("encode", 11)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            job = sched.job_info(jid)
+            if job["state"] == "running" and job["server"] == slow:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"job never dispatched to {slow}: {job}")
+        sched.drop_member(slow)  # the reaper noticed the member died
+        assert sched.wait([jid], timeout=30)
+        job = sched.job_info(jid)
+        assert job["state"] == "done", job
+        assert job["server"] == fast
+        assert job["shards"] == list(range(TOTAL_SHARDS))
+        st = sched.stats()
+        assert st["jobs_preempted"] == 1, st
+        # the slow member's late answer must not clobber the settled job
+        time.sleep(3.2)
+        job = sched.job_info(jid)
+        assert job["server"] == fast and job["shards"] != [99], job
+    finally:
+        slow_down()
+        fast_down()
+        sched.stop()
+
+
 # ------------------------------------------------ live daemons, dp=1 fleet
 @pytest.fixture()
 def fleet_cluster(tmp_path, monkeypatch):

@@ -1,0 +1,626 @@
+"""Chaos suite: kill a whole CLUSTER mid-replication, restart, converge.
+
+The crash matrix spawns a child (``python -c``) that runs two persistent
+clusters (sqlite filer store, on-disk volumes + meta log) in one process
+and drives filer.sync between them. A fault point armed after the seeded
+baseline hard-kills the child (``os._exit(113)``) at an exact step of the
+idempotent-apply protocol — mid-apply, between apply and marker, between
+marker and offset checkpoint. The parent then relaunches the child against
+the SAME state directories with no faults and asserts bidirectional
+convergence by full-tree content hash: zero drops, zero dupes, and the
+``redelivered`` counter proving the crash-window redelivery was a no-op
+rather than never exercised.
+
+The survivor test keeps cluster B alive in the pytest process while
+cluster A (plus the ReplicationController) lives in a killable child:
+kill A mid-storm, serve reads from B, fail writes over to B, restart A,
+prove both trees converge — the datacenter-loss drill end to end.
+
+In-process tests below cover LWW convergence under concurrent conflicting
+writes, DLQ park/replay through `weed shell remote.dlq`, the torn-park
+crash, and the `/_status` sync section. Fast subset runs in tier-1; the
+full matrix joins the soak (SWEED_SOAK=1).
+"""
+
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.client import FilerClient, FilerHTTPError
+from seaweedfs_tpu.replication import (
+    DeadLetterQueue,
+    FilerSync,
+    ReplicationController,
+)
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import http_bytes
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.util import faultpoints
+
+pytestmark = pytest.mark.crash
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def tree_hash(filer_url, root):
+    """path → sha1(content) for every file under root, via the filer API."""
+    c = FilerClient(filer_url)
+    out = {}
+    stack = [root]
+    while stack:
+        d = stack.pop()
+        for e in c.list(d):
+            p = e["full_path"]
+            if e.get("is_directory"):
+                stack.append(p)
+            else:
+                status, data, _ = c.get_object(p)
+                assert status == 200, f"{filer_url}{p} -> {status}"
+                out[p] = hashlib.sha1(data).hexdigest()
+    return out
+
+
+# The crash-matrix child: TWO persistent clusters + one sync direction in
+# one process. Ports and state live in the state dir so a relaunch resumes
+# the same topology — filer sqlite + meta log + volume dirs + master meta
+# all survive the kill.
+CHILD = r"""
+import json, os, sys, time
+
+statedir, op = sys.argv[1], sys.argv[2]
+faultspec = sys.argv[3] if len(sys.argv) > 3 else ""
+
+from seaweedfs_tpu.replication import FilerSync
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import http_bytes
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.util import faultpoints
+
+ports_file = os.path.join(statedir, "ports.json")
+if os.path.exists(ports_file):
+    with open(ports_file) as f:
+        ports = json.load(f)
+else:
+    import socket
+    def free_port():
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]; s.close(); return p
+    ports = {k: free_port() for k in ("ma", "va", "fa", "mb", "vb", "fb")}
+    with open(ports_file, "w") as f:
+        json.dump(ports, f)
+
+
+def mk_cluster(name):
+    vdir = os.path.join(statedir, "vol_" + name)
+    os.makedirs(vdir, exist_ok=True)
+    master = MasterServer(
+        port=ports["m" + name], node_timeout=60,
+        meta_dir=os.path.join(statedir, "meta_" + name),
+    ).start()
+    volume = VolumeServer(
+        [vdir], port=ports["v" + name], master_url=master.url,
+        max_volume_count=20, pulse_seconds=0.3,
+    ).start()
+    filer = FilerServer(
+        port=ports["f" + name], master_url=master.url, chunk_size=64 * 1024,
+        db_path=os.path.join(statedir, "filer_" + name + ".db"),
+    ).start()
+    return master, volume, filer
+
+
+def wait_ready(filer):
+    deadline = time.time() + 20
+    while True:
+        try:
+            s, _ = http_bytes(
+                "POST", "http://" + filer.url + "/probe/ready.txt", b"up"
+            )
+            if s < 300:
+                return
+        except OSError:
+            pass
+        if time.time() > deadline:
+            raise SystemExit("cluster " + filer.url + " never became ready")
+        time.sleep(0.2)
+
+
+def blob(tag, i):
+    return (tag + ":" + str(i) + "|").encode() * (37 + i * 13)
+
+
+def drain(sync, budget=90):
+    zeros, deadline = 0, time.time() + budget
+    while zeros < 2:
+        n = sync.sync_once()
+        zeros = zeros + 1 if n == 0 else 0
+        if time.time() > deadline:
+            raise SystemExit("sync did not converge within budget")
+        if n == 0:
+            time.sleep(0.1)
+
+
+ca = mk_cluster("a")
+cb = mk_cluster("b")
+wait_ready(ca[2])
+wait_ready(cb[2])
+fa, fb = ca[2], cb[2]
+sync = FilerSync(fa.url, fb.url, source_path="/sync", target_path="/sync")
+
+if op == "storm":
+    # baseline: seeded files synced clean, offset checkpointed, markers GC'd
+    for i in range(8):
+        http_bytes("POST", "http://%s/sync/seed_%03d.bin" % (fa.url, i),
+                   blob("seed", i))
+    drain(sync)
+    # arm the fault ONLY now: skip/count land inside the storm application
+    if faultspec:
+        faultpoints._parse_env(faultspec)
+    for i in range(24):
+        http_bytes("POST", "http://%s/sync/storm_%03d.bin" % (fa.url, i),
+                   blob("storm", i))
+    drain(sync)  # an armed crash fault kills us somewhere in here
+elif op == "resync":
+    drain(sync)
+    print("STATS " + json.dumps(sync.stats()))
+    import hashlib
+    from seaweedfs_tpu.filer.client import FilerClient
+
+    def tree(url):
+        c = FilerClient(url)
+        out, stack = {}, ["/sync"]
+        while stack:
+            d = stack.pop()
+            for e in c.list(d):
+                p = e["full_path"]
+                if e.get("is_directory"):
+                    stack.append(p)
+                else:
+                    st, data, _ = c.get_object(p)
+                    assert st == 200, (url, p, st)
+                    out[p] = hashlib.sha1(data).hexdigest()
+        return out
+
+    print("HASH " + json.dumps({"a": tree(fa.url), "b": tree(fb.url)}))
+else:
+    raise SystemExit("unknown op " + op)
+
+for c in (ca, cb):
+    c[2].stop(); c[1].stop(); c[0].stop()
+print("CHILD-COMPLETED")
+"""
+
+# The survivor child: cluster A + the ReplicationController, against a
+# cluster B living in the PARENT (the survivor). argv carries B's url.
+SURVIVOR_CHILD = r"""
+import json, os, sys, time
+
+statedir, op, b_url = sys.argv[1], sys.argv[2], sys.argv[3]
+faultspec = sys.argv[4] if len(sys.argv) > 4 else ""
+
+from seaweedfs_tpu.replication import ReplicationController
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import http_bytes
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.util import faultpoints
+
+ports_file = os.path.join(statedir, "ports.json")
+if os.path.exists(ports_file):
+    with open(ports_file) as f:
+        ports = json.load(f)
+else:
+    import socket
+    def free_port():
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]; s.close(); return p
+    ports = {k: free_port() for k in ("ma", "va", "fa")}
+    with open(ports_file, "w") as f:
+        json.dump(ports, f)
+
+vdir = os.path.join(statedir, "vol_a")
+os.makedirs(vdir, exist_ok=True)
+master = MasterServer(port=ports["ma"], node_timeout=60,
+                      meta_dir=os.path.join(statedir, "meta_a")).start()
+volume = VolumeServer([vdir], port=ports["va"], master_url=master.url,
+                      max_volume_count=20, pulse_seconds=0.3).start()
+filer = FilerServer(port=ports["fa"], master_url=master.url,
+                    chunk_size=64 * 1024,
+                    db_path=os.path.join(statedir, "filer_a.db")).start()
+
+deadline = time.time() + 20
+while True:
+    try:
+        s, _ = http_bytes("POST", "http://" + filer.url + "/probe/up.txt", b"x")
+        if s < 300:
+            break
+    except OSError:
+        pass
+    if time.time() > deadline:
+        raise SystemExit("cluster A never became ready")
+    time.sleep(0.2)
+
+ctrl = ReplicationController(filer.url, b_url, dlq_dir=statedir,
+                             source_path="/sync")
+
+def blob(i):
+    return ("storm:" + str(i) + "|").encode() * (37 + i * 13)
+
+def drain_both(budget=90):
+    zeros, deadline = 0, time.time() + budget
+    while zeros < 2:
+        n = ctrl.a_to_b.sync_once() + ctrl.b_to_a.sync_once()
+        zeros = zeros + 1 if n == 0 else 0
+        if time.time() > deadline:
+            raise SystemExit("active-active did not converge within budget")
+        if n == 0:
+            time.sleep(0.1)
+
+if op == "storm":
+    if faultspec:
+        faultpoints._parse_env(faultspec)
+    for i in range(20):
+        http_bytes("POST", "http://%s/sync/storm_%03d.bin" % (filer.url, i),
+                   blob(i))
+        # sync as we write so the armed fault lands MID-storm, with part of
+        # the batch already replicated to the survivor
+        ctrl.a_to_b.sync_once()
+    drain_both()
+elif op == "resync":
+    drain_both()
+    import hashlib
+    from seaweedfs_tpu.filer.client import FilerClient
+    c = FilerClient(filer.url)
+    out, stack = {}, ["/sync"]
+    while stack:
+        d = stack.pop()
+        for e in c.list(d):
+            p = e["full_path"]
+            if e.get("is_directory"):
+                stack.append(p)
+            else:
+                st, data, _ = c.get_object(p)
+                assert st == 200, (p, st)
+                out[p] = hashlib.sha1(data).hexdigest()
+    print("HASH " + json.dumps(out))
+else:
+    raise SystemExit("unknown op " + op)
+
+filer.stop(); volume.stop(); master.stop()
+print("CHILD-COMPLETED")
+"""
+
+
+def run_child(script, args, faultspec=None, expect_crash=False, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("SWEED_FAULTPOINTS", None)
+    argv = [sys.executable, "-c", script] + [str(a) for a in args]
+    if faultspec:
+        argv.append(faultspec)
+    proc = subprocess.run(
+        argv, cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    if expect_crash:
+        assert proc.returncode == faultpoints.CRASH_EXIT_CODE, (
+            f"child exited {proc.returncode}, wanted injected-crash "
+            f"{faultpoints.CRASH_EXIT_CODE}\nstderr: {proc.stderr[-2000:]}"
+        )
+        assert "CHILD-COMPLETED" not in proc.stdout
+    else:
+        assert proc.returncode == 0, (
+            f"child exited {proc.returncode}\nstdout: {proc.stdout[-1000:]}"
+            f"\nstderr: {proc.stderr[-2000:]}"
+        )
+        assert "CHILD-COMPLETED" in proc.stdout
+    return proc
+
+
+def child_json(proc, tag):
+    for ln in proc.stdout.splitlines():
+        if ln.startswith(tag + " "):
+            return json.loads(ln[len(tag) + 1:])
+    raise AssertionError(f"no {tag} line in child stdout: {proc.stdout[-500:]}")
+
+
+def assert_converged(proc, n_files=32, redelivered=None):
+    """Both trees byte-identical with the full expected population — tree
+    equality rules out drops AND stray extras; idempotent re-apply rules
+    out dupes by construction (same path, same bytes)."""
+    trees = child_json(proc, "HASH")
+    assert trees["a"] == trees["b"], (
+        f"trees diverged after crash+restart:\n a-b: "
+        f"{set(trees['a'].items()) ^ set(trees['b'].items())}"
+    )
+    assert len(trees["a"]) == n_files, sorted(trees["a"])
+    stats = child_json(proc, "STATS")
+    if redelivered is not None:
+        assert stats["redelivered"] >= redelivered, stats
+    assert stats["parked"] == 0, stats
+    return stats
+
+
+# mid-apply / between apply and marker / between markers and checkpoint:
+# every window of the idempotence protocol, at an offset inside the batch
+FULL_MATRIX = [
+    ("repl.sink.write=crash", 0),       # crash before ANY storm apply
+    ("repl.sink.write=crash::3", 1),    # 3 applied+marked, no checkpoint
+    ("repl.apply.marker=crash::2", 1),  # applied but marker not yet durable
+    ("repl.offset.checkpoint=crash", 1),  # all marked, offset never moved
+    ("repl.read.source=crash::5", 1),   # die fetching content mid-batch
+]
+
+# tier-1 subset: one crash per distinct protocol window
+FAST_MATRIX = [
+    ("repl.sink.write=crash::3", 1),
+    ("repl.apply.marker=crash::2", 1),
+    ("repl.offset.checkpoint=crash", 1),
+]
+
+
+def test_chaos_child_completes_without_faults(tmp_path):
+    """Harness sanity: unfaulted storm+resync converge with 0 redeliveries,
+    so a matrix pass means the faults fired, not that sync never ran."""
+    run_child(CHILD, [tmp_path, "storm"])
+    proc = run_child(CHILD, [tmp_path, "resync"])
+    stats = assert_converged(proc, redelivered=0)
+    assert stats["redelivered"] == 0, stats
+
+
+@pytest.mark.parametrize("faultspec,min_redelivered", FAST_MATRIX)
+def test_crash_matrix_fast(tmp_path, faultspec, min_redelivered):
+    run_child(CHILD, [tmp_path, "storm"], faultspec, expect_crash=True)
+    proc = run_child(CHILD, [tmp_path, "resync"])
+    assert_converged(proc, redelivered=min_redelivered)
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.skipif(
+    os.environ.get("SWEED_SOAK") != "1",
+    reason="full replication crash matrix is soak-gated; fast subset "
+           "covers tier-1",
+)
+@pytest.mark.parametrize("faultspec,min_redelivered", FULL_MATRIX)
+def test_crash_matrix_full(tmp_path, faultspec, min_redelivered):
+    run_child(CHILD, [tmp_path, "storm"], faultspec, expect_crash=True)
+    proc = run_child(CHILD, [tmp_path, "resync"])
+    assert_converged(proc, redelivered=min_redelivered)
+
+
+def test_survivor_serves_reads_and_failover(tmp_path):
+    """Datacenter-loss drill: cluster A dies mid-write-storm; the survivor
+    keeps serving what replicated; writes fail over to it; restarted A
+    converges bidirectionally — storm files AND failover files on both."""
+    mb = MasterServer(port=free_port(), node_timeout=60).start()
+    vb = VolumeServer(
+        [str(tmp_path / "vol_b")], port=free_port(), master_url=mb.url,
+        max_volume_count=20, pulse_seconds=0.3,
+    ).start()
+    fb = FilerServer(
+        port=free_port(), master_url=mb.url, chunk_size=64 * 1024
+    ).start()
+    try:
+        deadline = time.time() + 20
+        while True:
+            s, _ = http_bytes("POST", f"http://{fb.url}/probe/b.txt", b"x")
+            if s < 300:
+                break
+            assert time.time() < deadline, "survivor cluster never ready"
+            time.sleep(0.2)
+        # A dies after ~10 of 20 storm files were pushed over
+        run_child(
+            SURVIVOR_CHILD, [tmp_path, "storm", fb.url],
+            "repl.sink.write=crash::10", expect_crash=True,
+        )
+        # the survivor serves reads of what made it across
+        replicated = tree_hash(fb.url, "/sync")
+        assert len(replicated) >= 5, sorted(replicated)
+        for p in list(replicated)[:3]:
+            status, data, _ = FilerClient(fb.url).get_object(p)
+            assert status == 200 and data
+        # traffic fails over: clients write to the survivor
+        for i in range(5):
+            s, _ = http_bytes(
+                "POST", f"http://{fb.url}/sync/failover_{i}.bin",
+                f"failover:{i}".encode() * 50,
+            )
+            assert s < 300
+        # A comes back; both directions drain; trees must converge
+        proc = run_child(SURVIVOR_CHILD, [tmp_path, "resync", fb.url])
+        tree_a = child_json(proc, "HASH")
+        tree_b = tree_hash(fb.url, "/sync")
+        assert tree_a == tree_b, (
+            f"diverged: {set(tree_a.items()) ^ set(tree_b.items())}"
+        )
+        # the crash hit file 9's apply (skip=10 covers the /sync mkdir plus
+        # files 0-8), so A durably wrote storm files 0-9 and nothing after;
+        # convergence = those 10 plus the 5 failover writes, on both sides
+        assert len(tree_a) == 15, sorted(tree_a)
+        assert sum(1 for p in tree_a if "failover" in p) == 5, sorted(tree_a)
+    finally:
+        fb.stop()
+        vb.stop()
+        mb.stop()
+
+
+# -- in-process: LWW convergence, DLQ ops, /_status ---------------------------
+
+
+@pytest.fixture(scope="module")
+def two_clusters(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("chaos_live")
+
+    def mk(name):
+        master = MasterServer(port=free_port(), node_timeout=60).start()
+        volume = VolumeServer(
+            [str(tmp / name)], port=free_port(), master_url=master.url,
+            max_volume_count=20, pulse_seconds=0.5,
+        ).start()
+        filer = FilerServer(
+            port=free_port(), master_url=master.url, chunk_size=64 * 1024
+        ).start()
+        return master, volume, filer
+
+    a, b = mk("a"), mk("b")
+    time.sleep(0.6)
+    yield a[2], b[2]
+    for cluster in (a, b):
+        cluster[2].stop()
+        cluster[1].stop()
+        cluster[0].stop()
+
+
+def test_lww_concurrent_conflicting_writes_converge(two_clusters, tmp_path):
+    """Concurrent A/B writes to the SAME paths while both directions run:
+    both sides settle on one winner per path (no ping-pong, no split
+    brain), and the winner is one of the two candidate versions."""
+    fa, fb = two_clusters
+    ctrl = ReplicationController(
+        fa.url, fb.url, dlq_dir=str(tmp_path), source_path="/lww"
+    ).start()
+    try:
+        candidates = {}
+        for i in range(6):
+            p = f"/lww/doc_{i}.txt"
+            va, vb_ = f"A wrote {i}".encode(), f"B wrote {i}".encode()
+            candidates[p] = {hashlib.sha1(va).hexdigest(),
+                             hashlib.sha1(vb_).hexdigest()}
+            http_bytes("POST", f"http://{fa.url}{p}", va)
+            http_bytes("POST", f"http://{fb.url}{p}", vb_)
+        deadline = time.time() + 30
+        stable_since = None
+        while True:
+            ta, tb = tree_hash(fa.url, "/lww"), tree_hash(fb.url, "/lww")
+            if ta == tb and len(ta) == 6:
+                if stable_since is None:
+                    stable_since = time.time()
+                elif time.time() - stable_since > 1.5:
+                    break  # converged AND stayed converged: no ping-pong
+            else:
+                stable_since = None
+            assert time.time() < deadline, f"no convergence: {ta} vs {tb}"
+            time.sleep(0.3)
+        for p, h in ta.items():
+            assert h in candidates[p], f"{p} settled on neither version"
+        s = ctrl.stats()
+        assert s["a_to_b"]["parked"] == 0 and s["b_to_a"]["parked"] == 0
+        # the losing side of each conflict was LWW-gated somewhere
+        assert s["a_to_b"]["lww_skipped"] + s["b_to_a"]["lww_skipped"] >= 1, s
+    finally:
+        ctrl.stop()
+
+
+def test_dlq_park_replay_roundtrip_via_shell(two_clusters, tmp_path):
+    """A poison event (HTTP 400 from the sink) parks instead of wedging the
+    stream; `weed shell remote.dlq` lists it and -replay re-applies it."""
+    from seaweedfs_tpu.shell.commands import CommandEnv
+    from seaweedfs_tpu.shell.shell import run_command
+
+    fa, fb = two_clusters
+    dlq = DeadLetterQueue(str(tmp_path / "dlq.a_to_b.jsonl"))
+    sync = FilerSync(fa.url, fb.url, source_path="/dlqt",
+                     target_path="/dlqt", direction="a_to_b", dlq=dlq)
+    s, _ = http_bytes("POST", f"http://{fa.url}/dlqt/poison.bin",
+                      b"parked payload" * 20)
+    assert s < 300
+
+    real_create = sync.sink.create_entry
+
+    def poisoned(path, *a, **k):
+        if path.endswith("poison.bin"):
+            raise FilerHTTPError("PUT", path, 400, b"schema rejected")
+        return real_create(path, *a, **k)
+
+    sync.sink.create_entry = poisoned
+    n = sync.sync_once()  # parks the poison event, does NOT stall
+    assert n >= 1
+    assert sync.parked == 1 and dlq.depth() == 1
+    # offset moved PAST the parked event: the stream is not wedged
+    assert sync.sync_once() == 0
+    sync.sink.create_entry = real_create
+
+    env = CommandEnv(fa.master_seeds[0], filer=fa.url)
+    listing = run_command(env, f"remote.dlq -dir={tmp_path}")
+    assert listing["a_to_b"]["depth"] == 1
+    entry = listing["a_to_b"]["entries"][0]
+    assert entry["path"] == "/dlqt/poison.bin"
+    assert "400" in entry["error"]
+
+    replayed = run_command(env, f"remote.dlq -dir={tmp_path} -replay")
+    assert replayed["a_to_b"] == {"replayed": 1, "failed": 0}
+    assert dlq.depth() == 0
+    status, data, _ = FilerClient(fb.url).get_object("/dlqt/poison.bin")
+    assert status == 200 and data == b"parked payload" * 20
+
+
+def test_status_exposes_sync_section(two_clusters, tmp_path):
+    """/_status carries per-direction sync gauges while a controller runs —
+    and stays reachable when stats are read with the peer conceptually
+    down (stats() is network-free by contract)."""
+    fa, fb = two_clusters
+    ctrl = ReplicationController(
+        fa.url, fb.url, dlq_dir=str(tmp_path), source_path="/statx"
+    )
+    try:
+        for url in (fa.url, fb.url):
+            s, body = http_bytes("GET", f"http://{url}/_status")
+            assert s == 200
+            sync = json.loads(body)["sync"]
+            assert set(sync["directions"]) >= {"a_to_b", "b_to_a"}
+            d = sync["directions"]["a_to_b"]
+            for k in ("replicated", "redelivered", "lww_skipped", "retries",
+                      "parked", "stalls", "inflight", "lag_s", "offset_ns"):
+                assert k in d, d
+            assert "dlq_depth" in d
+            assert sync["totals"]["dlq_depth"] == 0
+    finally:
+        ctrl.stop()
+
+
+# -- DLQ torn-park crash: a parked record must survive the same crash ---------
+
+TORN_PARK_CHILD = r"""
+import sys
+from seaweedfs_tpu.replication import DeadLetterQueue
+from seaweedfs_tpu.util import faultpoints
+
+path = sys.argv[1]
+dlq = DeadLetterQueue(path)
+ev1 = {"ts_ns": 1111, "new_entry": {"full_path": "/p/first.bin"}}
+dlq.park("a_to_b", "src:1", "tgt:2", ev1, Exception("poison #1"))
+# power loss mid-append of the SECOND record: torn-write truncates the
+# file after flush, before fsync, then hard-exits
+faultpoints.arm("notify.file.append", "torn-write", arg=0.6)
+ev2 = {"ts_ns": 2222, "new_entry": {"full_path": "/p/second.bin"}}
+dlq.park("a_to_b", "src:1", "tgt:2", ev2,
+         Exception("poison #2 " + "x" * 2000))
+print("CHILD-COMPLETED")
+"""
+
+
+def test_dlq_survives_torn_park(tmp_path):
+    path = str(tmp_path / "dlq.a_to_b.jsonl")
+    run_child(TORN_PARK_CHILD, [path], expect_crash=True, timeout=60)
+    dlq = DeadLetterQueue(path)
+    recs = dlq.entries()  # torn trailing record tolerated, first intact
+    assert [r["path"] for r in recs] == ["/p/first.bin"]
+    assert recs[0]["error"] == "poison #1"
+    out = dlq.replay(apply=lambda rec: None)
+    assert out == {"replayed": 1, "failed": 0}
+    assert dlq.depth() == 0

@@ -1,0 +1,225 @@
+"""util/retry.py — the shared bounded-backoff helper — plus the
+notification-queue durability fixes that ride on it (PR 10): MemoryQueue
+drop-oldest overflow, FileQueue fsync'd appends and torn-trailing-line
+tolerance."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+
+import pytest
+
+from seaweedfs_tpu.filer.client import FilerHTTPError
+from seaweedfs_tpu.replication.notification import FileQueue, MemoryQueue
+from seaweedfs_tpu.util.faultpoints import FaultError
+from seaweedfs_tpu.util.retry import (
+    POISON,
+    TRANSIENT,
+    RetryError,
+    RetryPolicy,
+    backoff_delays,
+    classify_error,
+    retry_call,
+)
+
+NOSLEEP = lambda d: None  # noqa: E731 — tests never really wait
+
+
+# -- retry_call ----------------------------------------------------------------
+
+def test_success_first_try_no_sleep():
+    sleeps = []
+    out = retry_call(lambda: 42, sleep=sleeps.append)
+    assert out == 42
+    assert sleeps == []
+
+
+def test_transient_then_success_counts_retries():
+    calls = {"n": 0}
+    retried = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("reset")
+        return "ok"
+
+    out = retry_call(
+        flaky,
+        policy=RetryPolicy(attempts=3, base_s=0.01, deadline_s=60),
+        on_retry=lambda e, attempt, d: retried.append((attempt, d)),
+        sleep=NOSLEEP,
+    )
+    assert out == "ok"
+    assert calls["n"] == 3
+    assert [a for a, _ in retried] == [1, 2]
+
+
+def test_poison_raises_immediately_permanent():
+    calls = {"n": 0}
+
+    def poison():
+        calls["n"] += 1
+        raise ValueError("bad request shape")
+
+    with pytest.raises(RetryError) as ei:
+        retry_call(poison, sleep=NOSLEEP)
+    assert calls["n"] == 1  # no second try on poison
+    assert ei.value.permanent is True
+    assert isinstance(ei.value.last, ValueError)
+
+
+def test_transient_exhaustion_not_permanent():
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise ConnectionError("refused")
+
+    with pytest.raises(RetryError) as ei:
+        retry_call(
+            always_down,
+            policy=RetryPolicy(attempts=4, base_s=0.01, deadline_s=60),
+            sleep=NOSLEEP,
+        )
+    assert calls["n"] == 4
+    assert ei.value.permanent is False
+    assert ei.value.attempts == 4
+    assert isinstance(ei.value.last, ConnectionError)
+
+
+def test_retry_after_stretches_the_backoff():
+    sleeps = []
+
+    def overloaded():
+        e = ConnectionError("503")
+        e.retry_after = 1.5  # the peer said when to come back
+        raise e
+
+    with pytest.raises(RetryError):
+        retry_call(
+            overloaded,
+            policy=RetryPolicy(attempts=2, base_s=0.01, cap_s=0.1,
+                               deadline_s=60, jitter=False),
+            sleep=sleeps.append,
+        )
+    assert sleeps == [1.5]  # max(computed 0.01, retry_after 1.5)
+
+
+def test_deadline_cuts_the_loop_short():
+    calls = {"n": 0}
+
+    def down():
+        calls["n"] += 1
+        e = ConnectionError("down")
+        e.retry_after = 10.0  # next sleep would blow the deadline
+        raise e
+
+    with pytest.raises(RetryError) as ei:
+        retry_call(
+            down,
+            policy=RetryPolicy(attempts=5, base_s=0.01, deadline_s=0.5),
+            sleep=NOSLEEP,
+        )
+    assert calls["n"] == 1  # gave up instead of sleeping past the deadline
+    assert ei.value.permanent is False
+
+
+def test_custom_classifier_overrides_default():
+    calls = {"n": 0}
+
+    def fails():
+        calls["n"] += 1
+        raise ValueError("transient in THIS protocol")
+
+    with pytest.raises(RetryError) as ei:
+        retry_call(
+            fails,
+            policy=RetryPolicy(attempts=2, base_s=0.01, deadline_s=60),
+            classify=lambda e: TRANSIENT,
+            sleep=NOSLEEP,
+        )
+    assert calls["n"] == 2
+    assert ei.value.permanent is False
+
+
+# -- classify_error ------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "exc,want",
+    [
+        (FilerHTTPError("PUT", "/a", 503), TRANSIENT),
+        (FilerHTTPError("PUT", "/a", 429), TRANSIENT),
+        (FilerHTTPError("PUT", "/a", 404), POISON),
+        (FilerHTTPError("PUT", "/a", 400), POISON),
+        (urllib.error.HTTPError("u", 500, "ISE", {}, None), TRANSIENT),
+        (urllib.error.HTTPError("u", 403, "forbidden", {}, None), POISON),
+        (urllib.error.URLError(OSError("refused")), TRANSIENT),
+        (ConnectionResetError("reset"), TRANSIENT),
+        (TimeoutError("slow"), TRANSIENT),
+        (FaultError("repl.sink.write"), TRANSIENT),  # io-error faults = EIO
+        (ValueError("programming error"), POISON),
+        (KeyError("missing"), POISON),
+    ],
+    ids=lambda x: repr(x)[:40],
+)
+def test_classify_error(exc, want):
+    assert classify_error(exc) == want
+
+
+def test_backoff_delays_count_and_cap():
+    p = RetryPolicy(attempts=5, base_s=0.1, cap_s=0.3, jitter=False)
+    ds = list(backoff_delays(p))
+    assert ds == [0.1, 0.2, 0.3, 0.3]  # attempts-1 delays, capped
+    # jittered delays stay within [0, deterministic]
+    pj = RetryPolicy(attempts=5, base_s=0.1, cap_s=0.3, jitter=True)
+    for want, got in zip(ds, backoff_delays(pj)):
+        assert 0 <= got <= want
+
+
+# -- MemoryQueue overflow ------------------------------------------------------
+
+def test_memory_queue_drops_oldest_on_overflow():
+    q = MemoryQueue(maxsize=3)
+    for i in range(5):
+        q.send(f"/k{i}", {"i": i})
+    assert q.dropped == 2
+    got = [q.receive(timeout=0.01) for _ in range(3)]
+    # the two OLDEST entries went; the newest three survived in order
+    assert [k for k, _ in got] == ["/k2", "/k3", "/k4"]
+    assert q.receive(timeout=0.01) is None
+
+
+# -- FileQueue durability ------------------------------------------------------
+
+def test_file_queue_round_trip(tmp_path):
+    q = FileQueue(str(tmp_path / "events.jsonl"))
+    q.send("/a", {"n": 1})
+    q.send("/b", {"n": 2})
+    recs = q.read_all()
+    assert [(r["key"], r["message"]["n"]) for r in recs] == [("/a", 1),
+                                                             ("/b", 2)]
+
+
+def test_file_queue_tolerates_torn_trailing_line(tmp_path):
+    path = tmp_path / "events.jsonl"
+    q = FileQueue(str(path))
+    q.send("/a", {"n": 1})
+    q.send("/b", {"n": 2})
+    # model a crash mid-append: a partial record with no newline at EOF
+    with open(path, "a") as f:
+        f.write('{"key": "/c", "mess')
+    recs = q.read_all()
+    assert [r["key"] for r in recs] == ["/a", "/b"]
+    assert q.torn_lines == 1
+
+
+def test_file_queue_mid_file_corruption_raises(tmp_path):
+    path = tmp_path / "events.jsonl"
+    with open(path, "w") as f:
+        f.write('{"key": "/a", "message": {}}\n')
+        f.write("NOT JSON AT ALL\n")  # mid-file, NOT a crash artifact
+        f.write('{"key": "/b", "message": {}}\n')
+    with pytest.raises(json.JSONDecodeError):
+        FileQueue(str(path)).read_all()

@@ -32,6 +32,7 @@ CASES = [
     ("broad-except", "broad_except", "server/fixture.py"),
     ("resource-leak", "resource_leak", "server/fixture.py"),
     ("bounded-window", "bounded_window", "server/fixture.py"),
+    ("unbounded-retry", "unbounded_retry", "server/fixture.py"),
     # interprocedural rules (analysis/lockgraph.py, analysis/taint.py)
     ("lock-order", "lock_order", "cluster/fixture.py"),
     ("blocking-under-lock", "blocking_under_lock", "storage/fixture.py"),
