@@ -773,6 +773,245 @@ def probe_serving(mode: str, conns_csv: str, total: int) -> None:
     print(json.dumps(out))
 
 
+def probe_trace(total: int = 8000, conns: int = 16) -> None:
+    """Child mode: the tracing tax + the cluster-wide trace tree.
+
+    Two three-daemon clusters (master+volume+filer, each its own process,
+    SWEED_TURBO=0 so the measured path is the Python data plane the spans
+    instrument): one with SWEED_TRACE=1, one with SWEED_TRACE=0. The same
+    keep-alive smallfile GET storm runs against each (best of 3 reps);
+    the rps delta is the always-on tracing overhead, budgeted at <=2%.
+
+    With the traced cluster still up, one multi-chunk PUT and one GET are
+    issued and their response trace ids walked back through every
+    daemon's /debug/traces ring via the shell collector — the assembled
+    tree (filer root → master assign → volume writes) is the acceptance
+    artifact for end-to-end propagation across REAL process boundaries,
+    not the in-process ring the unit tests see.
+
+    Prints one JSON line:
+    {"rps": {"traced", "untraced"}, "overhead_pct", "within_budget",
+     "put_trace": {...}, "get_trace": {...}}
+    """
+    import asyncio
+    import socket
+    import tempfile
+
+    from seaweedfs_tpu.filer.client import FilerClient
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def wait_port(port, timeout=20.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                return
+            except OSError:
+                time.sleep(0.1)
+        raise RuntimeError(f"server on :{port} never came up")
+
+    def spawn(code, extra_env):
+        env = dict(os.environ)
+        env.update(extra_env)
+        return subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+
+    async def storm(fp, paths, bodies, c, n_total):
+        """Closed-loop keep-alive GET storm; returns verified rps."""
+        counters = {"failed": 0, "mismatched": 0}
+        done = [0]
+
+        async def worker(wid, n_req):
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection("127.0.0.1", fp), timeout=10
+                )
+            except (OSError, asyncio.TimeoutError):
+                counters["failed"] += n_req
+                return
+            try:
+                for k in range(n_req):
+                    p = paths[(wid + k) % len(paths)]
+                    writer.write(
+                        (f"GET {p} HTTP/1.1\r\nHost: b\r\n"
+                         f"Content-Length: 0\r\n\r\n").encode()
+                    )
+                    try:
+                        await writer.drain()
+                        head = await asyncio.wait_for(
+                            reader.readuntil(b"\r\n\r\n"), 60
+                        )
+                        clen = 0
+                        for ln in head.split(b"\r\n"):
+                            if ln.lower().startswith(b"content-length:"):
+                                clen = int(ln.split(b":")[1])
+                        body = await asyncio.wait_for(
+                            reader.readexactly(clen), 60
+                        )
+                    except (OSError, asyncio.TimeoutError,
+                            asyncio.IncompleteReadError):
+                        counters["failed"] += n_req - k
+                        return
+                    if body != bodies[p]:
+                        counters["mismatched"] += 1
+                    done[0] += 1
+            finally:
+                writer.close()
+
+        per = [n_total // c + (1 if i < n_total % c else 0)
+               for i in range(c)]
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(i, per[i]) for i in range(c)
+                               if per[i]))
+        wall = max(time.perf_counter() - t0, 1e-3)
+        return {
+            "rps": round(done[0] / wall, 1),
+            "failed": counters["failed"],
+            "mismatched": counters["mismatched"],
+        }
+
+    def start_cluster(trace_on, tmp):
+        serve_env = {
+            "SWEED_SERVING": "threads",
+            "SWEED_TURBO": "0",
+            "SWEED_TRACE": "1" if trace_on else "0",
+        }
+        mp, vp, fp = free_port(), free_port(), free_port()
+        procs = [spawn(
+            "import time\n"
+            "from seaweedfs_tpu.server.master_server import MasterServer\n"
+            f"MasterServer(host='127.0.0.1', port={mp}).start()\n"
+            "time.sleep(3600)\n",
+            serve_env,
+        )]
+        wait_port(mp)
+        procs.append(spawn(
+            "import time\n"
+            "from seaweedfs_tpu.server.volume_server import VolumeServer\n"
+            f"VolumeServer([{tmp!r}], host='127.0.0.1', port={vp}, "
+            f"master_url='127.0.0.1:{mp}').start()\n"
+            "time.sleep(3600)\n",
+            serve_env,
+        ))
+        procs.append(spawn(
+            "import time\n"
+            "from seaweedfs_tpu.server.filer_server import FilerServer\n"
+            f"FilerServer(host='127.0.0.1', port={fp}, "
+            f"master_url='127.0.0.1:{mp}').start()\n"
+            "time.sleep(3600)\n",
+            serve_env,
+        ))
+        wait_port(vp)
+        wait_port(fp)
+        time.sleep(0.5)  # volume heartbeat → master topology
+        client = FilerClient(f"127.0.0.1:{fp}")
+        import numpy as np
+
+        rng = np.random.default_rng(13)
+        bodies = {}
+        for i in range(64):
+            data = rng.integers(0, 256, 1024, dtype=np.uint8).tobytes()
+            client.put_object(f"/t/{i}", data)
+            bodies[f"/t/{i}"] = data
+        paths = sorted(bodies)
+        for p in paths:  # warm the filer chunk cache
+            client.get_object(p)
+        return procs, mp, fp, paths, bodies
+
+    def stop(procs):
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def _collect_probe_trees(mp, fp):
+        from seaweedfs_tpu.server.http_util import http_bytes_headers
+        from seaweedfs_tpu.shell.commands import CommandEnv, trace_collect
+
+        env = CommandEnv(master=f"127.0.0.1:{mp}",
+                         filer=f"127.0.0.1:{fp}")
+        trees = {}
+        blob = os.urandom(200_000)  # multi-chunk → assign + volume hops
+        for key, (method, body) in (
+            ("put_trace", ("POST", blob)),
+            ("get_trace", ("GET", None)),
+        ):
+            st, _, hdrs = http_bytes_headers(
+                method, f"http://127.0.0.1:{fp}/probe/trace.bin", body
+            )
+            tid = {k.lower(): v for k, v in hdrs.items()}.get(
+                "x-sweed-trace-id", ""
+            )
+            time.sleep(0.3)  # streamed spans land after the reply
+            report = trace_collect(env, tid) if tid else {}
+            tree = report.get("tree", "")
+            trees[key] = {
+                "status": st,
+                "trace_id": tid,
+                "span_count": report.get("span_count", 0),
+                "services": sorted({
+                    ln.split()[0] for ln in tree.splitlines() if ln.strip()
+                }),
+                "tree": tree,
+            }
+        return trees
+
+    # both clusters stay resident together and the storms alternate
+    # between them: this host's run-to-run drift (shared CPU, frequency
+    # scaling) is far larger than a 2% effect, and interleaving puts the
+    # same drift on both sides of the subtraction
+    import statistics
+
+    with tempfile.TemporaryDirectory() as tmp_on, \
+            tempfile.TemporaryDirectory() as tmp_off:
+        procs_on = procs_off = None
+        try:
+            procs_on, mp_on, fp_on, paths_on, bodies_on = (
+                start_cluster(True, tmp_on))
+            procs_off, _, fp_off, paths_off, bodies_off = (
+                start_cluster(False, tmp_off))
+            reps_on, reps_off = [], []
+            for _ in range(5):
+                reps_on.append(asyncio.run(
+                    storm(fp_on, paths_on, bodies_on, conns, total)))
+                reps_off.append(asyncio.run(
+                    storm(fp_off, paths_off, bodies_off, conns, total)))
+            trees = _collect_probe_trees(mp_on, fp_on)
+        finally:
+            if procs_on:
+                stop(procs_on)
+            if procs_off:
+                stop(procs_off)
+    rps_on = round(statistics.median(r["rps"] for r in reps_on), 1)
+    rps_off = round(statistics.median(r["rps"] for r in reps_off), 1)
+    overhead = round((rps_off - rps_on) / max(rps_off, 1e-9) * 100.0, 2)
+    print(json.dumps({
+        "rps": {"traced": rps_on, "untraced": rps_off},
+        "rps_reps": {"traced": [r["rps"] for r in reps_on],
+                     "untraced": [r["rps"] for r in reps_off]},
+        "failed": {"traced": sum(r["failed"] for r in reps_on),
+                   "untraced": sum(r["failed"] for r in reps_off)},
+        "mismatched": {"traced": sum(r["mismatched"] for r in reps_on),
+                       "untraced": sum(r["mismatched"] for r in reps_off)},
+        "overhead_pct": overhead,
+        "within_budget": overhead <= 2.0,
+        "put_trace": trees.get("put_trace"),
+        "get_trace": trees.get("get_trace"),
+    }))
+
+
 def probe_hotshard(n_needles: int, n_requests: int) -> None:
     """Child mode: the hot-shard story end to end — zipfian (s≈1.1) GET
     storm against a prepopulated 2-node cluster, measured cold/random,
@@ -1892,6 +2131,28 @@ def main() -> None:
                 f"{serving['aio_vs_threads']['aio_paced_p99_vs_low_conns']}x "
                 f"its c={lo} paced p99")
 
+    # -- tracing tax + the multi-daemon trace tree ---------------------------
+    trace_bench = None
+    try:
+        r = _run_probe(["--probe-trace", "8000", "16"], timeout=420)
+        if r.returncode == 0 and r.stdout.strip():
+            trace_bench = json.loads(r.stdout.strip().splitlines()[-1])
+            put_svcs = (trace_bench.get("put_trace") or {}).get(
+                "services", []
+            )
+            log(
+                f"trace: {trace_bench['rps']['traced']} req/s traced vs "
+                f"{trace_bench['rps']['untraced']} untraced "
+                f"({trace_bench['overhead_pct']}% tax, within 2% budget: "
+                f"{trace_bench['within_budget']}); PUT tree spans "
+                f"{put_svcs}"
+            )
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            log(f"trace probe failed: {tail[0][:140]}")
+    except subprocess.TimeoutExpired:
+        log("trace probe timed out")
+
     # -- hot-shard path: zipfian storm vs heat rebalance + needle cache -------
     hotshard = None
     try:
@@ -2155,6 +2416,7 @@ def main() -> None:
                 "smallfile": smallfile,
                 "filer_pipe": filer_pipe,
                 "serving": serving,
+                "trace": trace_bench,
                 "hotshard": hotshard,
                 "sync": sync_bench,
                 "e2e": e2e,
@@ -2203,6 +2465,9 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 4 and sys.argv[1] == "--probe-serving":
         probe_serving(sys.argv[2], sys.argv[3],
                       int(sys.argv[4]) if len(sys.argv) > 4 else 20000)
+    elif sys.argv[1:2] == ["--probe-trace"]:
+        probe_trace(int(sys.argv[2]) if len(sys.argv) > 2 else 8000,
+                    int(sys.argv[3]) if len(sys.argv) > 3 else 16)
     elif sys.argv[1:2] == ["--probe-sync"]:
         probe_sync(int(sys.argv[2]) if len(sys.argv) > 2 else 120,
                    float(sys.argv[3]) if len(sys.argv) > 3 else 6.0)

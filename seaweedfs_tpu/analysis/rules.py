@@ -1,4 +1,4 @@
-"""The five sweedlint rules.
+"""The sweedlint rules.
 
 Each rule is a singleton object with:
 
@@ -693,6 +693,100 @@ class UnboundedRetry:
         return None
 
 
+class MetricCardinality:
+    """Metric label values must come from bounded sets: the registry holds
+    one series per distinct label combination FOREVER, so labeling a
+    counter with a per-request identifier (path, fid, trace id, volume
+    id) turns it into a memory leak and a /metrics payload bomb — the
+    exact failure Prometheus docs warn about under "cardinality".
+
+    Flags ``inc()`` / ``set()`` / ``observe()`` / ``time()`` keyword
+    arguments whose NAME — or whose value's terminal identifier, including
+    through f-strings — names such an identifier. Bounded dynamic labels
+    (a fleet member's url, a configured sync direction's name) pass: the
+    rule keys on identifier names, not on dynamism — proving a variable
+    bounded is the reviewer's job, catching the known-unbounded ids is
+    the lint's. Exemplar keywords on the histogram API itself
+    (``observe(v, trace_id=...)``) route trace ids BESIDE the label set,
+    not into it, so ``stats/`` is exempt."""
+
+    name = "metric-cardinality"
+
+    _METHODS = frozenset({"inc", "set", "observe", "time"})
+
+    #: per-request / per-object identifier names — unbounded by
+    #: construction. Deliberately small: url/member/direction/name label
+    #: bounded fleets and configured directions today.
+    _UNBOUNDED = frozenset(
+        {
+            "path",
+            "full_path",
+            "file_path",
+            "filepath",
+            "fid",
+            "file_id",
+            "nid",
+            "needle_id",
+            "trace_id",
+            "traceid",
+            "span_id",
+            "vid",
+            "volume_id",
+            "object_key",
+        }
+    )
+
+    _EXEMPT = ("stats/histogram.py", "stats/metrics.py", "stats/trace.py")
+
+    def applies_to(self, relpath: str) -> bool:
+        return not any(relpath.endswith(e) for e in self._EXEMPT)
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._METHODS
+                and node.keywords
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue  # **labels passthrough: the source site is
+                    # where the identifier enters, flag there
+                bad = kw.arg if kw.arg in self._UNBOUNDED else (
+                    self._unbounded_value(kw.value)
+                )
+                if bad:
+                    out.append(
+                        Violation(
+                            self.name,
+                            relpath,
+                            node.lineno,
+                            f"metric label {kw.arg}={bad!r} is a "
+                            "per-request identifier: every distinct value "
+                            "becomes a series the registry holds forever; "
+                            "put it in a span tag or log line instead",
+                        )
+                    )
+        return out
+
+    def _unbounded_value(self, value: ast.AST) -> Optional[str]:
+        """Terminal identifier of the label VALUE when it names a known
+        per-request id: ``op=path``, ``op=entry.full_path``, and f-strings
+        interpolating either (``op=f"get {path}"``)."""
+        if isinstance(value, ast.JoinedStr):
+            for part in value.values:
+                if isinstance(part, ast.FormattedValue):
+                    hit = self._unbounded_value(part.value)
+                    if hit:
+                        return hit
+            return None
+        t = _terminal_name(value)
+        return t if t in self._UNBOUNDED else None
+
+
 RULES = [
     LockDiscipline(),
     Durability(),
@@ -701,4 +795,5 @@ RULES = [
     ResourceLeak(),
     BoundedWindow(),
     UnboundedRetry(),
+    MetricCardinality(),
 ]

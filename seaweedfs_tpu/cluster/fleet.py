@@ -48,6 +48,15 @@ from ..util.retry import RetryPolicy
 _JOB_TIMEOUT = 600.0
 
 
+from ..stats.metrics import default_registry as _registry
+
+#: EC job round-trip latency (dispatch to settle), labeled by job kind
+JOB_HIST = _registry.histogram(
+    "fleet_job_encode_seconds",
+    "fleet EC job round-trip latency (dispatch to settle), by kind",
+)
+
+
 @dataclass
 class EcJob:
     id: int
@@ -279,15 +288,24 @@ class EcJobScheduler:
             job.server = target
         path = "generate" if job.kind == "encode" else "rebuild"
         from ..server.http_util import http_json
+        from ..stats import trace as _trace
 
         t0 = time.monotonic()
         try:
-            r = http_json(
-                "POST",
-                f"http://{target}/admin/ec/{path}?volume={job.vid}"
-                f"&collection={job.collection}",
-                timeout=_JOB_TIMEOUT,
-            )
+            # scheduler worker threads run detached from any request
+            # context: root a fresh trace per dispatch so the member-side
+            # /admin/ec/* span nests under it (header injected by the
+            # pooled transport), and time the whole round-trip
+            with _trace.start_span(
+                f"ec_{job.kind}", service="fleet",
+                vid=job.vid, member=target,
+            ), JOB_HIST.time(kind=job.kind):
+                r = http_json(
+                    "POST",
+                    f"http://{target}/admin/ec/{path}?volume={job.vid}"
+                    f"&collection={job.collection}",
+                    timeout=_JOB_TIMEOUT,
+                )
         except Exception as e:
             # transport-level failure (member died, refused, timed out):
             # retry on a DIFFERENT member with backoff, attempts permitting
@@ -387,6 +405,11 @@ class EcJobScheduler:
                 "jobs_retried": self._retries,
                 "jobs_preempted": self._preempted,
                 "jobs": [self._jobs[j].info() for j in tail],
+                # dispatch round-trip quantiles from fleet_job_encode_seconds
+                "job_latency": {
+                    "encode": JOB_HIST.summary(kind="encode"),
+                    "rebuild": JOB_HIST.summary(kind="rebuild"),
+                },
             }
 
     def stop(self) -> None:

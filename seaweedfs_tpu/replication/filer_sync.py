@@ -53,6 +53,8 @@ import threading
 from typing import Optional
 
 from ..filer.client import FilerClient
+from ..stats import trace as _trace
+from ..stats.metrics import default_registry
 from ..util import faultpoints, glog
 from ..util.retry import (
     REPLICATION_POLICY,
@@ -67,6 +69,12 @@ from .sink import FilerSink
 #: paces the outer poll loop while a peer cluster is down — the loop never
 #: exits (datacenter loss is survivable, not fatal), it just slows down
 LOOP_POLICY = RetryPolicy(attempts=6, base_s=0.2, cap_s=5.0, deadline_s=1e9)
+
+#: cross-cluster apply latency (event fetch excluded): one bucket set per
+#: process, label = sync direction name (bounded by configured directions)
+APPLY_HIST = default_registry.histogram(
+    "replication_apply_seconds", "cross-cluster event apply latency"
+)
 
 
 class SyncStalled(Exception):
@@ -194,7 +202,14 @@ class FilerSync:
             "Repl-Src": str(self.src_sig),
         }
         try:
-            self.replicator.replicate(ev)
+            # the sync thread has no ambient request context: root a fresh
+            # trace here so the target-filer hops (sink writes ride the
+            # pooled transport, which injects the header) nest under it
+            with _trace.start_span(
+                "apply", service="replication",
+                direction=self.direction, ts_ns=str(ev["ts_ns"]),
+            ), APPLY_HIST.time(direction=self.direction):
+                self.replicator.replicate(ev)
         finally:
             self.sink.stamp = {}
 
@@ -341,4 +356,7 @@ class FilerSync:
             "inflight": self.inflight,
             "offset_ns": self._offset_cache,
             "lag_s": round(lag_s, 3),
+            # apply-latency quantiles from the replication_apply_seconds
+            # histogram (same buckets that feed /metrics)
+            "apply_latency": APPLY_HIST.summary(direction=self.direction),
         }

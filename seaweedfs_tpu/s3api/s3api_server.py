@@ -29,6 +29,8 @@ from ..server.http_util import (
     relay_stream,
     start_server,
 )
+from ..stats import trace as _trace
+from ..stats.metrics import default_registry
 from ..util.parsers import parse_ascii_uint
 from ..util.pipeline import BoundedExecutor, prefetch_iter
 from . import auth as s3auth
@@ -103,6 +105,10 @@ class S3ApiServer:
     ):
         self.host, self.port = host, port
         self.client = FilerClient(filer_url)
+        # object/bucket op latency; op label is method × path-kind (bounded)
+        self._req_hist = default_registry.histogram(
+            "s3_request_seconds", "s3 gateway request latency"
+        )
         self.iam = iam or IAM()
         self._policy_cache: dict = {}  # bucket → (BucketPolicy | None,)
         self._policy_lock = threading.Lock()  # handler threads race the cache
@@ -1226,41 +1232,64 @@ class S3ApiServer:
                     body = (reader, length)
                 else:
                     body = self.rfile.read(length) if length else b""
-                try:
-                    result = api.handle(method, parsed.path, query, headers, body)
-                except Exception as e:  # noqa: BLE001
-                    result = 500, error_xml("InternalError", str(e), parsed.path)
-                if reader is not None and reader.left > 0:
-                    # refused before the body was consumed: bounded,
-                    # timeout-guarded drain (http_util.drain_refused_body)
-                    drain_refused_body(self, reader)
-                if len(result) == 2:
-                    status, payload = result
-                    extra = {}
-                else:
-                    status, payload, extra = result
-                self.send_response(status)
-                streaming = hasattr(payload, "read")
-                clen = extra.pop("Content-Length-Override", None)
-                ctype = extra.pop(
-                    "Content-Type",
-                    "application/xml" if payload else "application/octet-stream",
-                )
-                self.send_header("Content-Type", ctype)
-                if streaming:
-                    self.send_header("Content-Length", clen)  # always set
-                else:
-                    self.send_header("Content-Length", clen or str(len(payload)))
-                for k, v in extra.items():
-                    self.send_header(k, v)
-                self.end_headers()
-                if streaming:
-                    if method == "HEAD":
-                        payload.close()
+                # span + latency classification: bucket vs object op keeps
+                # the label space bounded (full path rides the span tag)
+                p = parsed.path.strip("/")
+                kind = "object" if "/" in p else ("bucket" if p else "service")
+                with _trace.start_span(
+                    f"{method} s3:{kind}",
+                    service="s3",
+                    parent_header=headers.get(_trace.TRACE_HEADER),
+                    path=parsed.path,
+                ) as span, api._req_hist.time(op=f"{kind}_{method.lower()}"):
+                    try:
+                        result = api.handle(
+                            method, parsed.path, query, headers, body
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        result = 500, error_xml(
+                            "InternalError", str(e), parsed.path
+                        )
+                    if reader is not None and reader.left > 0:
+                        # refused before the body was consumed: bounded,
+                        # timeout-guarded drain (http_util.drain_refused_body)
+                        drain_refused_body(self, reader)
+                    if len(result) == 2:
+                        status, payload = result
+                        extra = {}
                     else:
-                        relay_stream(self, payload, int(clen))
-                elif method != "HEAD" and payload:
-                    self.wfile.write(payload)
+                        status, payload, extra = result
+                    if span is not None:
+                        span.tags["status"] = status
+                        if status >= 500:
+                            span.status = "error"
+                        extra.setdefault(_trace.TRACE_ID_HEADER, span.trace_id)
+                    self.send_response(status)
+                    streaming = hasattr(payload, "read")
+                    clen = extra.pop("Content-Length-Override", None)
+                    ctype = extra.pop(
+                        "Content-Type",
+                        "application/xml"
+                        if payload
+                        else "application/octet-stream",
+                    )
+                    self.send_header("Content-Type", ctype)
+                    if streaming:
+                        self.send_header("Content-Length", clen)  # always set
+                    else:
+                        self.send_header(
+                            "Content-Length", clen or str(len(payload))
+                        )
+                    for k, v in extra.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    if streaming:
+                        if method == "HEAD":
+                            payload.close()
+                        else:
+                            relay_stream(self, payload, int(clen))
+                    elif method != "HEAD" and payload:
+                        self.wfile.write(payload)
 
             def do_GET(self):
                 self._go("GET")

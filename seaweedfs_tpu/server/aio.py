@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextvars
 import io
 import socket
 import threading
@@ -457,8 +458,14 @@ class AioHTTPServer:
                 raw_requestline = head[: idx + 2]
                 rfile.set_head(head[idx + 2:])
                 try:
+                    # run_in_executor does NOT propagate contextvars (only
+                    # task creation copies context) — copy explicitly so
+                    # ambient tracing context crosses the loop→worker
+                    # bridge, the same guarantee the threads core gets for
+                    # free from running handlers on the request thread
+                    ctx = contextvars.copy_context()
                     close = await self._loop.run_in_executor(
-                        self._pool, _run_request,
+                        self._pool, ctx.run, _run_request,
                         self.handler_cls, self, conn, rfile, wfile,
                         (peer[0], peer[1] if len(peer) > 1 else 0),
                         raw_requestline,

@@ -35,6 +35,7 @@ from typing import Optional
 
 from ..util.locks import lock_stats, make_lock
 from ..stats import serving_stats
+from ..stats import trace as _trace
 from .. import operation
 from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import MAX_INT64, view_from_chunks
@@ -548,6 +549,14 @@ class FilerServer:
             # cross-cluster replication: per-direction lag/inflight/dlq
             # (network-free snapshot — readable while the peer is down)
             "sync": self._sync_stats_safe(),
+            # request-latency quantiles straight from the cumulative-bucket
+            # histograms that also feed /metrics (no parallel bookkeeping)
+            "request_latency": {
+                "write": self._req_hist.summary(op="write"),
+                "read": self._req_hist.summary(op="read"),
+                "read_stream": self._req_hist.summary(op="read_stream"),
+            },
+            "trace": _trace.trace_stats(),
         }
 
     def _h_metrics(self, h, path, q, body):
@@ -1147,7 +1156,9 @@ class FilerServer:
         fs = self
 
         class Handler(JsonHandler):
+            trace_service = "filer"
             routes = [
+                ("GET", "/_debug/traces", _trace.h_debug_traces),
                 ("GET", "/_assign", fs._h_assign),
                 ("GET", "/_meta/events", fs._h_meta_events),
                 ("GET", "/_meta/watch", fs._h_meta_watch),

@@ -14,6 +14,22 @@ import urllib.request
 
 from seaweedfs_tpu.util import glog
 
+from ..stats import trace as _trace
+
+
+def _trace_headers(headers: Optional[dict]) -> Optional[dict]:
+    """Outbound header injection point for EVERY internal HTTP call: when
+    a span is active on this thread, the request carries
+    ``X-Sweed-Trace: <trace_id>:<span_id>`` so the receiving daemon's
+    server span joins the caller's tree. The original dict is never
+    mutated; an explicit caller-set trace header wins."""
+    hv = _trace.inject_header()
+    if hv is None:
+        return headers
+    out = dict(headers or {})
+    out.setdefault(_trace.TRACE_HEADER, hv)
+    return out
+
 
 # -- serving-core shared state ------------------------------------------------
 def serving_mode() -> str:
@@ -318,6 +334,9 @@ class JsonHandler(BaseHTTPRequestHandler):
     routes: list[tuple[str, str, Callable]] = []
     server_ctx: Any = None
     extra_headers: Optional[dict] = None  # handlers may set per-request
+    # span service tag for this daemon's server spans ("master", "filer",
+    # "volume", "s3", ...); subclasses override
+    trace_service: str = "http"
 
     def log_message(self, fmt, *args):  # stdlib chatter → V(3)
         glog.V(3).info("http: " + fmt, *args)
@@ -345,30 +364,60 @@ class JsonHandler(BaseHTTPRequestHandler):
         for m, prefix, fn in self.routes:
             if m == method and parsed.path.startswith(prefix):
                 streaming = getattr(fn, "_streaming", False)
-                try:
-                    if streaming:
-                        status, payload = fn(
-                            self, parsed.path, query, self.rfile, length
-                        )
-                    else:
-                        if body is None:
-                            body = self.rfile.read(length) if length else b""
-                        status, payload = fn(self, parsed.path, query, body)
-                except BadRequest as e:
-                    status, payload = 400, {"error": str(e)}
-                    if streaming:
-                        # the request body may be half-consumed; keep-alive
-                        # framing is gone, so drop the connection after reply
-                        self.close_connection = True
-                except Exception as e:
-                    glog.exception("%s %s failed", method, parsed.path)
-                    status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
-                    if streaming:
-                        # the request body may be half-consumed; keep-alive
-                        # framing is gone, so drop the connection after reply
-                        self.close_connection = True
-                glog.V(2).info("%s %s → %d", method, parsed.path, status)
-                self._reply(status, payload, head_only=(method == "HEAD"))
+                # server span: this runs on the request's worker thread in
+                # BOTH cores (the aio reactor copies the loop context into
+                # its pool), so the contextvar window is same-thread. The
+                # span name is the ROUTE prefix, not the raw path — bounded
+                # names; the path rides in a tag. The reply happens inside
+                # the span so streamed bodies count toward the hop time.
+                with _trace.start_span(
+                    f"{method} {prefix}",
+                    service=self.trace_service,
+                    parent_header=self.headers.get(_trace.TRACE_HEADER),
+                    path=parsed.path,
+                ) as span:
+                    try:
+                        if streaming:
+                            status, payload = fn(
+                                self, parsed.path, query, self.rfile, length
+                            )
+                        else:
+                            if body is None:
+                                body = (self.rfile.read(length)
+                                        if length else b"")
+                            status, payload = fn(self, parsed.path, query,
+                                                 body)
+                    except BadRequest as e:
+                        status, payload = 400, {"error": str(e)}
+                        if streaming:
+                            # the request body may be half-consumed;
+                            # keep-alive framing is gone, so drop the
+                            # connection after reply
+                            self.close_connection = True
+                    except Exception as e:
+                        glog.exception("%s %s failed", method, parsed.path)
+                        status, payload = 500, {
+                            "error": f"{type(e).__name__}: {e}"
+                        }
+                        if streaming:
+                            # the request body may be half-consumed;
+                            # keep-alive framing is gone, so drop the
+                            # connection after reply
+                            self.close_connection = True
+                    if span is not None:
+                        span.tags["status"] = status
+                        if status >= 500:
+                            span.status = "error"
+                        if self.extra_headers is None:
+                            self.extra_headers = {
+                                _trace.TRACE_ID_HEADER: span.trace_id
+                            }
+                        else:
+                            self.extra_headers.setdefault(
+                                _trace.TRACE_ID_HEADER, span.trace_id
+                            )
+                    glog.V(2).info("%s %s → %d", method, parsed.path, status)
+                    self._reply(status, payload, head_only=(method == "HEAD"))
                 return
         if body is None and length:
             # drain in bounded pieces for keep-alive correctness — a multi-GB
@@ -859,7 +908,7 @@ def http_stream_request(
     A consumed reader cannot be rewound, so there is NO stale-socket
     retry — instead the pooled socket is liveness-probed before the first
     byte goes out (the common stale case: peer restarted while idle)."""
-    hdrs = dict(headers or {})
+    hdrs = dict(_trace_headers(headers) or {})
     hdrs.setdefault("Content-Length", str(length))
     if not url.startswith("http://"):
         req = urllib.request.Request(
@@ -954,6 +1003,7 @@ def http_stream_response(
     checked out of the pool until the body is fully read, so a nested
     request to the same peer on this thread gets its own socket);
     anything else falls back to urllib."""
+    headers = _trace_headers(headers)
     if not url.startswith("http://"):
         req = urllib.request.Request(url, method=method, headers=headers or {})
         try:
@@ -1064,6 +1114,7 @@ def http_bytes_headers(
     endpoints carry metadata such as X-Compaction-Revision there).
     ``idempotent`` opts a POST into the stale-socket one-shot retry
     (fid-addressed uploads are safe to re-send; assigns are not)."""
+    headers = _trace_headers(headers)
     if url.startswith("http://"):
         return _pooled_request(method, url, body, headers, timeout,
                                idempotent=idempotent)

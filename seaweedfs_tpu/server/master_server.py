@@ -23,6 +23,8 @@ from ..cluster.fleet import EcJobScheduler
 from ..cluster.master import Master
 from ..cluster.topology import DataNode
 from ..stats import serving_stats
+from ..stats.metrics import default_registry
+from ..stats import trace
 from ..util import glog
 from ..util.parsers import tolerant_ufloat, tolerant_uint
 from .http_util import JsonHandler, http_json, start_server
@@ -64,6 +66,12 @@ class MasterServer:
         self._srv = None
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # assign latency (MasterReceivedHeartbeatCounter analog for the
+        # hot allocation path): /_status p50/p99 read from here
+        self._assign_hist = default_registry.histogram(
+            "master_assign_seconds",
+            "fid allocation latency through /dir/assign",
+        )
         # HA (raft_server.go analog): single master ⇒ immediate self-leader
         from ..cluster.election import LeaderElection
 
@@ -139,13 +147,14 @@ class MasterServer:
 
     # -- handlers ------------------------------------------------------------
     def _h_assign(self, h, path, q, body):
-        res = self.master.assign(
-            count=tolerant_uint(q.get("count", 1), 1),
-            replication=q.get("replication", ""),
-            collection=q.get("collection", ""),
-            ttl=q.get("ttl", ""),
-            data_center=q.get("dataCenter", ""),
-        )
+        with self._assign_hist.time(op="assign"):
+            res = self.master.assign(
+                count=tolerant_uint(q.get("count", 1), 1),
+                replication=q.get("replication", ""),
+                collection=q.get("collection", ""),
+                ttl=q.get("ttl", ""),
+                data_center=q.get("dataCenter", ""),
+            )
         out = {
             "fid": res.fid,
             "url": res.url,
@@ -266,6 +275,9 @@ class MasterServer:
             "serving": serving_stats(),
             # fleet EC scheduler: mesh members + job ledger (sweed_fleet_*)
             "fleet": self.fleet.stats(),
+            # assign latency quantiles from the cumulative-bucket histogram
+            "assign": self._assign_hist.summary(op="assign"),
+            "trace": trace.trace_stats(),
         }
 
     # -- fleet EC scheduling (cluster/fleet.py) ------------------------------
@@ -326,6 +338,10 @@ class MasterServer:
 
     def _h_ping(self, h, path, q, body):
         return 200, {"ok": True, "url": self.url}
+
+    def _h_metrics(self, h, path, q, body):
+        h.extra_headers = {"Content-Type": "text/plain; version=0.0.4"}
+        return 200, default_registry.expose().encode()
 
     def _h_leader_beat(self, h, path, q, body):
         import json
@@ -409,6 +425,7 @@ class MasterServer:
         ms = self
 
         class Handler(JsonHandler):
+            trace_service = "master"
             routes = [
                 # leader-only (writes/config): followers proxy to the leader
                 ("GET", "/dir/assign", ms._leader_only(ms._h_assign)),
@@ -441,6 +458,8 @@ class MasterServer:
                 ("GET", "/ui", ms._h_ui),
                 ("GET", "/dir/status", ms._h_status),
                 ("GET", "/cluster/status", ms._h_status),
+                ("GET", "/debug/traces", trace.h_debug_traces),
+                ("GET", "/metrics", ms._h_metrics),
             ]
 
         self._srv = start_server(Handler, self.host, self.port)

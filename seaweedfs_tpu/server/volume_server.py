@@ -499,6 +499,11 @@ class VolumeServer:
             return 403, {"error": "ip not allowed"}
         if not self._auth_ok(h, path, q, self.jwt_signing_key):
             return 401, {"error": "unauthorized write"}
+        self._req_count.inc(op="put")
+        with self._req_hist.time(op="put"):
+            return self._h_post_timed(h, path, q, body)
+
+    def _h_post_timed(self, h, path, q, body):
         # chaos/bench hook: delay here models cross-machine RTT + disk
         # latency per needle write (the wait the write window overlaps)
         faultpoints.fire("volume.write.needle")
@@ -1243,12 +1248,20 @@ class VolumeServer:
 
     def _h_status(self, h, path, q, body):
         from ..stats import heat_stats, scrub_stats
+        from ..stats import trace
 
         hb = self.store.collect_heartbeat()
         hb["ec"] = self.store.collect_ec_heartbeat()["ec_shards"]
         hb["heat"] = heat_stats()
         hb["ncache"] = self.ncache.stats()
         hb["scrub"] = scrub_stats()
+        # request-latency quantiles straight from the cumulative-bucket
+        # histograms that also feed /metrics (no parallel bookkeeping)
+        hb["request_latency"] = {
+            "get": self._req_hist.summary(op="get"),
+            "put": self._req_hist.summary(op="put"),
+        }
+        hb["trace"] = trace.trace_stats()
         return 200, hb
 
     def _h_ncache(self, h, path, q, body):
@@ -1491,8 +1504,12 @@ class VolumeServer:
             self._init_mesh()
         vs = self
 
+        from ..stats import trace as _trace
+
         class Handler(JsonHandler):
+            trace_service = "volume"
             routes = [
+                ("GET", "/debug/traces", _trace.h_debug_traces),
                 ("POST", "/admin/assign_volume", vs._h_assign_volume),
                 ("POST", "/admin/delete_volume", vs._h_delete_volume),
                 ("POST", "/_batch_delete", vs._h_batch_delete),

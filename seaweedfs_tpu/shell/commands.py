@@ -114,6 +114,49 @@ def cluster_status(env: CommandEnv) -> dict:
     return env.topology()
 
 
+def trace_collect(env: CommandEnv, trace_id: str) -> dict:
+    """Assemble one distributed trace from every daemon's /debug/traces
+    ring (weed shell has no analog; this is the Dapper-style collector
+    over the PR's span rings).
+
+    Queries the master, every heartbeat-live volume server, and the filer
+    (its ring rides the _-prefixed internal route so user files named
+    /debug/* stay reachable); daemons that are down contribute nothing —
+    partial trees still render, with orphan spans promoted to roots."""
+    from ..stats.trace import assemble_tree, format_tree
+
+    from ..util import glog
+
+    endpoints = [f"http://{env.master}/debug/traces"]
+    try:
+        endpoints += [
+            f"http://{n['url']}/debug/traces" for n in env.data_nodes()
+        ]
+    except Exception as e:  # noqa: BLE001
+        # master down: the filer ring may still hold the spans
+        glog.warning("trace: topology unavailable via %s: %s", env.master, e)
+    if env.filer:
+        endpoints.append(f"http://{env.filer}/_debug/traces")
+    spans: dict[str, dict] = {}  # span_id → span (in-process daemons share
+    unreachable = []  # a ring; dedup keeps each span once)
+    for url in endpoints:
+        try:
+            r = http_json("GET", f"{url}?trace={trace_id}")
+        except Exception:
+            unreachable.append(url)
+            continue
+        for s in r.get("spans", []):
+            spans.setdefault(s["span_id"], s)
+    roots = assemble_tree(spans.values())
+    return {
+        "trace_id": trace_id,
+        "span_count": len(spans),
+        "daemons_queried": len(endpoints),
+        "unreachable": unreachable,
+        "tree": format_tree(roots),
+    }
+
+
 def collection_list(env: CommandEnv) -> list[str]:
     return http_json("GET", f"http://{env.master}/col/list")["collections"]
 

@@ -44,6 +44,9 @@ HELP = """commands:
   query -path=FILE [-input=csv|json] 'SELECT ... FROM s3object [WHERE ...]'
   remote.dlq -dir=DLQ_DIR [-direction=a_to_b] [-replay]
                  list (or -replay) events parked by cross-cluster sync
+  trace TRACE_ID          assemble one distributed trace (filer→assign→
+                 volume span tree with per-hop timings) from every
+                 daemon's /debug/traces ring
   lock | unlock
   help | exit
 """
@@ -68,7 +71,7 @@ def _flags(parts: list[str]) -> dict[str, str]:
 _RETRY_SAFE = {
     "help", "cluster.status", "volume.list", "collection.list",
     "bucket.list", "fs.ls", "fs.du", "fs.tree", "fs.cat", "fs.pwd",
-    "fs.meta.cat", "query",
+    "fs.meta.cat", "query", "trace",
 }
 
 
@@ -256,6 +259,11 @@ def run_command(env: CommandEnv, line: str) -> object:
             flags.get("path", ""),
             flags.get("input", "csv"),
         )
+    if cmd == "trace":
+        tid = flags.get("id", "") or (args[0] if args else "")
+        if not tid:
+            raise ValueError("usage: trace TRACE_ID (or trace -id=TRACE_ID)")
+        return C.trace_collect(env, tid)
     if cmd == "remote.dlq":
         return C.remote_dlq(
             env,

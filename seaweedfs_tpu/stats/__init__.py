@@ -17,3 +17,10 @@ from .metrics import (  # noqa: F401
     serving_stats,
 )
 from .heat import EwmaHeat, heat_stats  # noqa: F401
+from .trace import (  # noqa: F401
+    Span,
+    TraceRing,
+    current_trace_id,
+    start_span,
+    trace_stats,
+)

@@ -10,17 +10,15 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 from typing import Optional
 
 from ..util import glog
-
-
-def _fmt_labels(labels: dict) -> str:
-    if not labels:
-        return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
-    return "{" + inner + "}"
+from .histogram import (  # noqa: F401  (re-exported: stats API surface)
+    _DEFAULT_BUCKETS,
+    Histogram,
+    _escape_label_value,
+    _fmt_labels,
+)
 
 
 class Counter:
@@ -35,7 +33,8 @@ class Counter:
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
 
     def total(self) -> float:
         """Sum across all label sets (for compact /_status views)."""
@@ -83,58 +82,6 @@ class Gauge:
                                    self.name, e)
         for key, v in sorted(items.items()):
             out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
-        return out
-
-
-_DEFAULT_BUCKETS = (
-    0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
-    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
-)
-
-
-class Histogram:
-    def __init__(self, name: str, help_: str = "", buckets=None):
-        self.name, self.help = name, help_
-        self.buckets = tuple(buckets or _DEFAULT_BUCKETS)
-        self._counts: dict[tuple, list[int]] = {}
-        self._sum: dict[tuple, float] = {}
-        self._total: dict[tuple, int] = {}
-        self._lock = threading.Lock()
-
-    def observe(self, value: float, **labels) -> None:
-        key = tuple(sorted(labels.items()))
-        with self._lock:
-            counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    counts[i] += 1
-            self._sum[key] = self._sum.get(key, 0.0) + value
-            self._total[key] = self._total.get(key, 0) + 1
-
-    def time(self, **labels):
-        """with hist.time(op="read"): ..."""
-        hist = self
-
-        class _Timer:
-            def __enter__(self):
-                self.t0 = time.perf_counter()
-
-            def __exit__(self, *exc):
-                hist.observe(time.perf_counter() - self.t0, **labels)
-
-        return _Timer()
-
-    def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for key in sorted(self._counts):
-            labels = dict(key)
-            for i, b in enumerate(self.buckets):
-                lb = {**labels, "le": repr(b)}
-                out.append(f"{self.name}_bucket{_fmt_labels(lb)} {self._counts[key][i]}")
-            lb = {**labels, "le": "+Inf"}
-            out.append(f"{self.name}_bucket{_fmt_labels(lb)} {self._total[key]}")
-            out.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sum[key]}")
-            out.append(f"{self.name}_count{_fmt_labels(labels)} {self._total[key]}")
         return out
 
 

@@ -15,7 +15,11 @@ priority order:
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # stdlib only on 3.11+
+    import tomli as tomllib  # type: ignore[no-redef]
 from typing import Any, Optional
 
 from . import glog

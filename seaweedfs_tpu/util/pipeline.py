@@ -23,6 +23,7 @@ N warm connections per peer, not N dials per chunk.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -64,6 +65,12 @@ def prefetch_iter(
     pool = ThreadPoolExecutor(
         max_workers=window, thread_name_prefix="prefetch"
     )
+    # fetches run with the consumer's context: a chunk fetch issued under
+    # a server span stays parented to it, so volume-hop spans join the
+    # filer request's trace instead of rooting fresh trees. Snapshot once,
+    # but enter a per-submit copy — a Context can only be entered by one
+    # thread at a time, and window>1 runs fetches concurrently
+    ctx = contextvars.copy_context()
     # queued-but-unyielded entries; holding completed results in this
     # deque is what caps resident data at window × chunk size
     pending: deque = deque()
@@ -80,7 +87,9 @@ def prefetch_iter(
                 k = key(item)
                 ent = by_key.get(k)
                 if ent is None:
-                    ent = by_key[k] = [pool.submit(fetch, item), 0]
+                    ent = by_key[k] = [
+                        pool.submit(ctx.copy().run, fetch, item), 0
+                    ]
                 ent[1] += 1
                 pending.append((item, k, ent[0]))
             if not pending:
@@ -133,10 +142,15 @@ class BoundedExecutor:
             # consuming the socket); drain/abort still settles the window
             raise self._first_error
         self._slots.acquire()
+        # each task carries the submitting thread's context: overlapped
+        # chunk uploads issued under a server span emit their volume hops
+        # into the same trace (contextvars do not cross pool threads on
+        # their own)
+        ctx = contextvars.copy_context()
 
         def run():
             try:
-                return fn(*args, **kwargs)
+                return ctx.run(fn, *args, **kwargs)
             except BaseException as e:
                 with self._error_lock:
                     if self._first_error is None:

@@ -1,0 +1,10 @@
+"""Fixture: per-request identifier as a metric label — metric-cardinality
+must fire exactly once."""
+
+from seaweedfs_tpu.stats.metrics import default_registry
+
+REQS = default_registry.counter("fixture_requests_total", "requests")
+
+
+def note_request(path):
+    REQS.inc(op=path)

@@ -1,0 +1,15 @@
+"""Fixture: bounded labels pass (op kinds, a fleet member's url), and a
+deliberate per-path series is waived — sweedlint must report nothing."""
+
+from seaweedfs_tpu.stats.metrics import default_registry
+
+REQS = default_registry.counter("fixture_requests_total", "requests")
+GBPS = default_registry.gauge("fixture_member_gbps", "per-member gbps")
+HIST = default_registry.histogram("fixture_seconds", "latency")
+
+
+def note_request(kind, member_url, path):
+    REQS.inc(op=kind)
+    GBPS.set(1.0, member=member_url)
+    HIST.observe(0.001, op=kind)
+    REQS.inc(op=path)  # sweedlint: ok metric-cardinality demo keeps a known-bounded path whitelist

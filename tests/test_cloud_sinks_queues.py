@@ -14,7 +14,11 @@ import json
 import socket
 import threading
 import time
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # stdlib only on 3.11+
+    import tomli as tomllib
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 

@@ -344,7 +344,11 @@ def _collect_wire(mode):
                 ("GET", "/ping", b""),  # keep-alive survived all of it
             ]:
                 st, hdrs, rbody = _request(c, method, path, body)
-                hdrs.pop("date", None)  # only legitimately varying header
+                hdrs.pop("date", None)  # legitimately varying
+                # Per-request random trace id; both modes must SEND it on any
+                # routed request (404s match no route, so no span opens).
+                tid = hdrs.pop("x-sweed-trace-id", None)
+                assert tid or st == 404, f"{path}: no trace id"
                 out.append((method, path, st, sorted(hdrs.items()), rbody))
         finally:
             c.close()

@@ -43,6 +43,9 @@ CASES = [
     # extent handle, the shard counters stay behind the shard lock
     ("resource-leak", "ncache_populate", "server/fixture.py"),
     ("lock-discipline", "ncache_shard", "storage/fixture.py"),
+    # PR 12 observability: per-request identifiers must stay out of
+    # metric label sets (they belong in span tags)
+    ("metric-cardinality", "metric_cardinality", "server/fixture.py"),
 ]
 
 
