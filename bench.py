@@ -1337,6 +1337,301 @@ def probe_hotshard(n_needles: int, n_requests: int) -> None:
     print(json.dumps(out))
 
 
+def probe_lifecycle(n_files: int = 64, n_requests: int = 4000) -> None:
+    """Child mode: the lifecycle autopilot under LIVE zipf traffic with a
+    drifting hot set, against a real in-process cluster (master + 2 volume
+    servers, numpy EC fleet, fake-S3 cold tier).
+
+    Phases: (seed) ``n_files`` files through ``/dir/assign`` across the
+    auto-grown volumes; (quiesced) paced zipf GET storm over hot set A
+    with the controller idle — baseline p50/p99; (live) the hot set
+    DRIFTS to a disjoint volume group and the same storm runs while a
+    ticker drives controller cycles every 0.5s, so set A cools and gets
+    EC'd/tiered underneath live reads; (settle) trickle reads keep set B
+    warm while cycles run until the plan goes quiet.  Every GET is
+    byte-verified through every tier transition — a read racing an EC
+    encode or an S3 upload must never return wrong bytes.
+
+    Ends with the heat-tracking verdict: volumes the drift left cold must
+    be EC'd or on the S3 tier, volumes in the live hot set must still be
+    plain+local, and ``p99_ratio`` (live/quiesced) bounds the maintenance
+    tax on tail latency.  Prints one JSON line."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    ZIPF_S = 1.1
+    HALFLIFE_S = 0.5
+    HOT_VOLS = 3  # hot-set width, in volumes (drift = disjoint group)
+    PAYLOAD_REPS = 512  # ~8KB per file
+
+    # knobs must land before any seaweedfs_tpu import: the heat halflife
+    # binds at stats.heat import time, the lifecycle config at master
+    # construction
+    os.environ["SWEED_HEAT_HALFLIFE"] = str(HALFLIFE_S)
+    os.environ["SWEED_MESH"] = "1"
+    os.environ["SWEED_LIFECYCLE_COLD_STREAK"] = "2"
+    os.environ["SWEED_LIFECYCLE_MAX_ACTIONS"] = "8"
+    os.environ["SWEED_LIFECYCLE_COOLDOWN"] = "3"
+    os.environ["SWEED_LIFECYCLE_BUDGETS"] = (
+        "ec=8,tier_up=4,tier_down=2,un_ec=2"
+    )
+    os.environ["SWEED_MAX_INFLIGHT"] = "10000"
+    for k in ("SWEED_LIFECYCLE", "SWEED_FAULTPOINTS", "SWEED_SCRUB",
+              "SWEED_TURBO", "SWEED_MESH_COORDINATOR", "NUM_PROCESSES",
+              "PROCESS_ID", "SWEED_TIER_ENDPOINT"):
+        os.environ.pop(k, None)
+
+    import socket as _socket
+
+    from seaweedfs_tpu.server.http_util import http_bytes, http_json
+    from seaweedfs_tpu.storage.backend.fake_s3 import FakeS3Server
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def payload_of(i: int) -> bytes:
+        return (b"lifecycle:%06d|" % i) * PAYLOAD_REPS
+
+    with tempfile.TemporaryDirectory() as tmp:
+        s3 = FakeS3Server(os.path.join(tmp, "s3")).start()
+        os.environ["SWEED_TIER_ENDPOINT"] = s3.endpoint
+
+        from seaweedfs_tpu.cluster.lifecycle import observe_topology
+        from seaweedfs_tpu.server.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+
+        master = MasterServer(
+            port=free_port(), node_timeout=60,
+            meta_dir=os.path.join(tmp, "meta"),
+        ).start()
+        vols = [
+            VolumeServer(
+                [os.path.join(tmp, f"v{k}")], port=free_port(),
+                master_url=master.url, max_volume_count=30,
+                pulse_seconds=0.3, ec_backend="numpy",
+            ).start()
+            for k in range(2)
+        ]
+        vurls = [f"{v.host}:{v.port}" for v in vols]
+        try:
+            # volume servers must be fleet members before fleet EC works
+            deadline = time.time() + 30
+            while True:
+                st = http_json(
+                    "GET", f"http://{master.url}/ec/fleet/status"
+                )
+                if len(st.get("members", [])) >= 2:
+                    break
+                if time.time() > deadline:
+                    raise RuntimeError("fleet members never registered")
+                time.sleep(0.2)
+
+            # -- seed -----------------------------------------------------
+            by_vid: dict[int, list] = {}
+            for i in range(n_files):
+                a = http_json("GET", f"http://{master.url}/dir/assign")
+                body = payload_of(i)
+                st, _ = http_bytes("POST", f"http://{a['url']}/{a['fid']}",
+                                   body)
+                if st != 201:
+                    raise RuntimeError(f"seed PUT {a['fid']}: HTTP {st}")
+                by_vid.setdefault(int(a["fid"].split(",")[0]), []).append(
+                    (a["fid"], body)
+                )
+            seeded = sorted(by_vid)
+            if len(seeded) < 2 * HOT_VOLS:
+                raise RuntimeError(
+                    f"only {len(seeded)} volumes seeded; need "
+                    f"{2 * HOT_VOLS} for a disjoint drift"
+                )
+            set_a, set_b = seeded[:HOT_VOLS], seeded[HOT_VOLS:2 * HOT_VOLS]
+
+            def zipf_requests(hot_vids, n):
+                """Zipf-weighted (fid, body) schedule over the hot set's
+                files, rank-ordered by volume so heat concentrates."""
+                files = [f for v in hot_vids for f in by_vid[v]]
+                ranks = np.arange(1, len(files) + 1, dtype=np.float64)
+                w = ranks ** -ZIPF_S
+                rng = np.random.default_rng(11)
+                picks = rng.choice(len(files), size=n, p=w / w.sum())
+                return [files[j] for j in picks]
+
+            def read_one(fid, body):
+                """Volume may be plain, mid-EC, EC, or on the S3 tier —
+                try both servers; correctness bar is byte equality."""
+                t0 = time.perf_counter()
+                for url in vurls:
+                    try:
+                        st, data = http_bytes("GET", f"http://{url}/{fid}")
+                    except OSError:
+                        continue
+                    if st == 200:
+                        return time.perf_counter() - t0, data == body
+                return time.perf_counter() - t0, None
+
+            def storm(reqs, duration_s):
+                lats, failed, mismatched = [], 0, 0
+                t_start = time.perf_counter()
+                pace = duration_s / max(1, len(reqs))
+                for k, (fid, body) in enumerate(reqs):
+                    tgt = t_start + k * pace
+                    now = time.perf_counter()
+                    if tgt > now:
+                        time.sleep(tgt - now)
+                    lat, ok = read_one(fid, body)
+                    if ok is None:
+                        failed += 1
+                    elif not ok:
+                        mismatched += 1
+                    else:
+                        lats.append(lat)
+                lat = sorted(lats)
+                n = len(lat)
+                wall = time.perf_counter() - t_start
+                return {
+                    "n": n,
+                    "rps": round(n / wall, 1) if wall > 0 else 0.0,
+                    "p50_ms": round(lat[n // 2] * 1e3, 2) if n else None,
+                    "p99_ms": round(
+                        lat[max(0, int(n * 0.99) - 1)] * 1e3, 2
+                    ) if n else None,
+                    "failed": failed,
+                    "mismatched": mismatched,
+                }
+
+            lc = master.lifecycle
+
+            # -- quiesced baseline: hot set A, controller idle ------------
+            quiesced = storm(zipf_requests(set_a, n_requests // 2), 6.0)
+
+            # -- live: hot set drifts to B while cycles run.  A trickle
+            # thread reads one file from EACH set-B volume continuously so
+            # the live hot set stays observably warm across slow cycles
+            # (a tier upload can outlast several heat halflives) — without
+            # it the autopilot correctly tiers B too and the "tracks heat"
+            # verdict has nothing to distinguish.
+            stop_probe = threading.Event()
+            summaries = []
+            trickle_counts = {"failed": 0}
+
+            def ticker():
+                while not stop_probe.is_set():
+                    try:
+                        summaries.append(lc.tick())
+                    except Exception as e:  # keep measuring through a bad cycle
+                        log(f"lifecycle tick error: {e}")
+                    stop_probe.wait(0.6)
+
+            def trickler():
+                while not stop_probe.is_set():
+                    for v in set_b:
+                        fid, body = by_vid[v][0]
+                        _, ok = read_one(fid, body)
+                        if ok is not True:
+                            trickle_counts["failed"] += 1
+                    stop_probe.wait(0.15)
+
+            tick_thread = threading.Thread(target=ticker, daemon=True)
+            trickle_thread = threading.Thread(target=trickler, daemon=True)
+            trickle_thread.start()
+            tick_thread.start()
+            live = storm(zipf_requests(set_b, n_requests // 2), 12.0)
+
+            # -- settle: cycles keep running until the plan goes quiet ----
+            settle_deadline = time.time() + 60
+            while time.time() < settle_deadline:
+                tail = summaries[-3:]
+                if len(tail) == 3 and not any(
+                    s["actions"] or s["deferred"] for s in tail
+                ):
+                    break
+                time.sleep(0.5)
+
+            # -- verdict: does the tier distribution track the heat? ------
+            time.sleep(0.8)  # one heartbeat so the observation is fresh
+            obs = observe_topology(master)
+            stop_probe.set()
+            tick_thread.join(timeout=30)
+            trickle_thread.join(timeout=10)
+            settle_failed = trickle_counts["failed"]
+            end_state = {}
+            for vid in sorted(obs):
+                ob = obs[vid]
+                state = ("tiered" if ob["tiered"]
+                         else "ec" if ob["kind"] == "ec" else "plain")
+                end_state[str(vid)] = {
+                    "heat": round(ob["heat"], 4),
+                    "band": ob["band"],
+                    "state": state,
+                    "seeded": vid in by_vid,
+                }
+            moved_cold = [
+                v for v in seeded if v not in set_b
+                and end_state[str(v)]["state"] != "plain"
+            ]
+            hot_local = [
+                v for v in set_b if end_state[str(v)]["state"] == "plain"
+            ]
+            cold_total = [v for v in seeded if v not in set_b]
+            st = lc.status()
+            out = {
+                "files": n_files,
+                "requests": n_requests,
+                "volumes_seeded": len(seeded),
+                "zipf_s": ZIPF_S,
+                "heat_halflife_s": HALFLIFE_S,
+                "hot_set_before": set_a,
+                "hot_set_after": set_b,
+                "quiesced": quiesced,
+                "live": live,
+                "p99_ratio": (
+                    round(live["p99_ms"] / quiesced["p99_ms"], 2)
+                    if live["p99_ms"] and quiesced["p99_ms"] else None
+                ),
+                "end_state": end_state,
+                "tracking": {
+                    "cold_moved": len(moved_cold),
+                    "cold_total": len(cold_total),
+                    "hot_still_local": len(hot_local),
+                    "hot_total": len(set_b),
+                    "fraction": round(
+                        (len(moved_cold) + len(hot_local))
+                        / max(1, len(cold_total) + len(set_b)), 3
+                    ),
+                },
+                "tier": {
+                    "s3_bytes": s3.bytes_stored(),
+                    "tiered_vids": [
+                        int(v) for v, e in end_state.items()
+                        if e["state"] == "tiered"
+                    ],
+                    "ec_vids": [
+                        int(v) for v, e in end_state.items()
+                        if e["state"] == "ec"
+                    ],
+                },
+                "actions": {
+                    k: st["counters"][k]
+                    for k in ("cycles", "actions_done", "actions_failed",
+                              "actions_deferred", "cycles_deferred")
+                },
+                "failed": quiesced["failed"] + live["failed"] + settle_failed,
+                "mismatched": quiesced["mismatched"] + live["mismatched"],
+            }
+        finally:
+            for v in vols:
+                v.stop()
+            master.stop()
+            s3.stop()
+    print(json.dumps(out))
+
+
 def probe_sync(n_files: int = 120, outage_s: float = 6.0) -> None:
     """Child mode: the active-active replication story end to end — a
     paced write storm against filer A with a live ReplicationController
@@ -2193,6 +2488,31 @@ def main() -> None:
     except subprocess.TimeoutExpired:
         log("sync probe timed out")
 
+    # -- lifecycle autopilot: drifting hot set, live re-tiering --------------
+    lifecycle_bench = None
+    try:
+        r = _run_probe(["--probe-lifecycle", "64", "4000"], timeout=420)
+        if r.returncode == 0 and r.stdout.strip():
+            lifecycle_bench = json.loads(r.stdout.strip().splitlines()[-1])
+            log(
+                f"lifecycle: quiesced p99="
+                f"{lifecycle_bench['quiesced']['p99_ms']}ms → live p99="
+                f"{lifecycle_bench['live']['p99_ms']}ms (ratio "
+                f"{lifecycle_bench['p99_ratio']}), tracking "
+                f"{lifecycle_bench['tracking']['fraction']} "
+                f"(cold moved {lifecycle_bench['tracking']['cold_moved']}/"
+                f"{lifecycle_bench['tracking']['cold_total']}, hot local "
+                f"{lifecycle_bench['tracking']['hot_still_local']}/"
+                f"{lifecycle_bench['tracking']['hot_total']}), s3 bytes "
+                f"{lifecycle_bench['tier']['s3_bytes']}, mismatched="
+                f"{lifecycle_bench['mismatched']}"
+            )
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            log(f"lifecycle probe failed: {tail[0][:140]}")
+    except subprocess.TimeoutExpired:
+        log("lifecycle probe timed out")
+
     # -- encode probes in fresh subprocesses ----------------------------------
     best, best_cfg, best_raw = 0.0, None, 0.0
     successes = 0
@@ -2419,6 +2739,7 @@ def main() -> None:
                 "trace": trace_bench,
                 "hotshard": hotshard,
                 "sync": sync_bench,
+                "lifecycle": lifecycle_bench,
                 "e2e": e2e,
                 "e2e_note": (
                     "all sinks tunnel-bound on this dev host (~100 MB/s "
@@ -2471,6 +2792,9 @@ if __name__ == "__main__":
     elif sys.argv[1:2] == ["--probe-sync"]:
         probe_sync(int(sys.argv[2]) if len(sys.argv) > 2 else 120,
                    float(sys.argv[3]) if len(sys.argv) > 3 else 6.0)
+    elif sys.argv[1:2] == ["--probe-lifecycle"]:
+        probe_lifecycle(int(sys.argv[2]) if len(sys.argv) > 2 else 64,
+                        int(sys.argv[3]) if len(sys.argv) > 3 else 4000)
     elif sys.argv[1:2] == ["--probe-hotshard"]:
         probe_hotshard(
             int(sys.argv[2]) if len(sys.argv) > 2 else 2_000_000,

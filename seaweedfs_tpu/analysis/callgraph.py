@@ -150,6 +150,7 @@ class Project:
             self._index_module(mi)
         for mi in self.modules.values():
             self._resolve_imports(mi)
+        self._chase_reexports()
         for ci in self.classes.values():
             ci.bases = [
                 b
@@ -208,7 +209,8 @@ class Project:
                     target = alias.name if alias.asname else alias.name.split(".")[0]
                     mi.symbols[bound] = ("module", target)
             elif isinstance(node, ast.ImportFrom):
-                base = self._resolve_from_base(mi.modname, node)
+                is_pkg = mi.relpath.endswith("__init__.py")
+                base = self._resolve_from_base(mi.modname, node, is_pkg)
                 if base is None:
                     continue
                 for alias in node.names:
@@ -222,16 +224,43 @@ class Project:
                         mi.symbols[bound] = ("symbol", target)
 
     @staticmethod
-    def _resolve_from_base(modname: str, node: ast.ImportFrom) -> Optional[str]:
+    def _resolve_from_base(
+        modname: str, node: ast.ImportFrom, is_pkg: bool = False
+    ) -> Optional[str]:
         if node.level == 0:
             return node.module or ""
+        # A package __init__'s own name counts as one level: `from .core
+        # import X` inside pkg/__init__.py resolves against pkg itself.
+        level = node.level - 1 if is_pkg else node.level
         parts = modname.split(".")
-        if node.level > len(parts):
+        if level > len(parts):
             return None
-        parts = parts[: len(parts) - node.level]
+        parts = parts[: len(parts) - level] if level else parts
         if node.module:
             parts.append(node.module)
         return ".".join(parts)
+
+    def _chase_reexports(self) -> None:
+        """Follow package ``__init__`` re-exports so a symbol imported as
+        ``pkg.Name`` lands on its defining module's qualname."""
+        for mi in self.modules.values():
+            for bound, (kind, target) in list(mi.symbols.items()):
+                if kind != "symbol":
+                    continue
+                seen: set[str] = set()
+                while (
+                    target not in self.classes
+                    and target not in self.functions
+                    and target not in seen
+                ):
+                    seen.add(target)
+                    owner, _, name = target.rpartition(".")
+                    src = self.modules.get(owner)
+                    nxt = src.symbols.get(name) if src else None
+                    if not nxt or nxt[0] != "symbol" or nxt[1] == target:
+                        break
+                    target = nxt[1]
+                mi.symbols[bound] = (kind, target)
 
     # -- symbol helpers -------------------------------------------------------
     def _resolve_symbol_to_class(

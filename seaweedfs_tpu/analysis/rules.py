@@ -787,6 +787,78 @@ class MetricCardinality:
         return t if t in self._UNBOUNDED else None
 
 
+class MaintenanceWithoutInterlock:
+    """Background maintenance — EC encodes/decodes, vacuum, tier moves,
+    replica moves — competes with serving traffic for the same spindles
+    and NICs. A LOOP that schedules maintenance over multiple volumes can
+    saturate the cluster exactly when a zipf storm needs it most, so any
+    such loop must consult the load interlock
+    (cluster/lifecycle.py ``LoadInterlock.maintenance_allowed`` — the
+    admission controller's inflight gauge vs the serving watermark)
+    between iterations, or carry a reasoned waiver explaining why some
+    OTHER throttle bounds it (an operator typing one command IS an
+    interlock; a daemon loop is not). One finding per loop, anchored on
+    the first maintenance call inside it."""
+
+    name = "maintenance-without-interlock"
+
+    #: terminal call names that schedule maintenance work
+    _MAINT = frozenset(
+        {
+            "ec_encode",
+            "ec_encode_fleet",
+            "ec_decode",
+            "ec_rebuild",
+            "volume_tier_upload",
+            "volume_tier_download",
+            "volume_move",
+            "volume_vacuum",
+            "tier_upload",
+            "tier_download",
+        }
+    )
+    #: consulting any of these inside the loop satisfies the rule
+    _CONSULT = frozenset({"maintenance_allowed", "allow_maintenance"})
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out: list[Violation] = []
+        seen_lines: set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            maint_line = None
+            consults = False
+            for n in ast.walk(node):
+                if not isinstance(n, ast.Call):
+                    continue
+                fn = _func_name(n)
+                if fn in self._MAINT and maint_line is None:
+                    maint_line = n.lineno
+                if fn in self._CONSULT:
+                    consults = True
+            if maint_line is None or consults:
+                continue
+            if maint_line in seen_lines:
+                continue  # nested loops: one finding per call site
+            seen_lines.add(maint_line)
+            out.append(
+                Violation(
+                    self.name,
+                    relpath,
+                    maint_line,
+                    "loop schedules maintenance without consulting the "
+                    "load interlock; call "
+                    "LoadInterlock.maintenance_allowed() between "
+                    "iterations (cluster/lifecycle.py) or waive with the "
+                    "throttle that bounds this loop",
+                )
+            )
+        return out
+
+
 RULES = [
     LockDiscipline(),
     Durability(),
@@ -796,4 +868,5 @@ RULES = [
     BoundedWindow(),
     UnboundedRetry(),
     MetricCardinality(),
+    MaintenanceWithoutInterlock(),
 ]

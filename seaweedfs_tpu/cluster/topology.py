@@ -37,6 +37,10 @@ class VolumeInfo:
     # (stats/heat.py); old servers simply never report them
     read_heat: float = 0.0
     write_heat: float = 0.0
+    # lifecycle signals: .dat lives on an S3-class remote backend, and how
+    # many needles the background scrub flagged as CRC-corrupt
+    remote_tier: bool = False
+    corrupt_needles: int = 0
 
     @classmethod
     def from_heartbeat(cls, m: dict) -> "VolumeInfo":
@@ -58,6 +62,8 @@ class VolumeInfo:
             compact_revision=m.get("compact_revision", 0),
             read_heat=m.get("read_heat", 0.0),
             write_heat=m.get("write_heat", 0.0),
+            remote_tier=m.get("remote_tier", False),
+            corrupt_needles=m.get("corrupt_needles", 0),
         )
 
 
@@ -160,6 +166,10 @@ class DataNode(Node):
         self.public_url = ""
         self.volumes: dict[int, VolumeInfo] = {}
         self.ec_shards: dict[int, int] = {}  # vid → shard bit mask
+        # lifecycle signals riding the EC heartbeat entries: decayed read
+        # heat per EC volume and scrub-flagged corrupt shard ids on this node
+        self.ec_read_heat: dict[int, float] = {}
+        self.ec_corrupt: dict[int, list[int]] = {}
         self.last_seen = 0.0
         self.pulse_seconds = 5.0  # node-reported beat interval
 
@@ -320,6 +330,8 @@ class Topology(Node):
                 affected.append(vid)
             dn.volumes = {}
             dn.ec_shards = {}
+            dn.ec_read_heat = {}
+            dn.ec_corrupt = {}
             dn.adjust_counts()
             if dn.parent:
                 dn.parent.children.pop(dn.id, None)
@@ -344,10 +356,16 @@ class Topology(Node):
     ) -> tuple[list[dict], list[dict]]:
         with self._lock:
             incoming: dict[int, int] = {}
+            heat: dict[int, float] = {}
+            corrupt: dict[int, set[int]] = {}
             for s in shards:  # OR-merge: one entry per disk location
-                incoming[s["id"]] = incoming.get(s["id"], 0) | s.get(
-                    "ec_index_bits", 0
-                )
+                vid = s["id"]
+                incoming[vid] = incoming.get(vid, 0) | s.get("ec_index_bits", 0)
+                h = s.get("read_heat", 0.0)
+                if h > heat.get(vid, 0.0):
+                    heat[vid] = h
+                if s.get("corrupt_shards"):
+                    corrupt.setdefault(vid, set()).update(s["corrupt_shards"])
             new_s, deleted_s = [], []
             for vid, bits in incoming.items():
                 old = dn.ec_shards.get(vid, 0)
@@ -361,6 +379,8 @@ class Topology(Node):
             for vid in set(dn.ec_shards) | set(incoming):
                 self._set_ec_shards(vid, dn, incoming.get(vid, 0))
             dn.ec_shards = incoming
+            dn.ec_read_heat = heat
+            dn.ec_corrupt = {v: sorted(s) for v, s in corrupt.items()}
             return new_s, deleted_s
 
     def _set_ec_shards(self, vid: int, dn: DataNode, bits: int) -> None:

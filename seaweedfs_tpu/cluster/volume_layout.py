@@ -37,6 +37,61 @@ def seed_placement(seed=None) -> None:
     _rng.seed(seed)
 
 
+# -- lifecycle heat thresholds (f4's hot→warm→cold bands) ---------------------
+# The lifecycle controller (cluster/lifecycle.py) classifies every volume by
+# its EWMA heat against three thresholds, env-tunable so probes and small
+# clusters can shrink the bands:
+#   heat >  ceiling                         hot   — un-EC / replica-boost
+#   floor <= heat <= ceiling                warm  — leave alone
+#   tier_floor <= heat < floor (streak)     cool  — fleet-EC, replicas reclaimed
+#   heat <  tier_floor         (streak)     cold  — tier the bytes to S3
+def heat_floor() -> float:
+    """Below this a plain volume is cooling toward the EC (warm) tier."""
+    import os
+
+    from ..util.parsers import tolerant_ufloat
+
+    return tolerant_ufloat(os.environ.get("SWEED_HEAT_FLOOR", ""), 0.05)
+
+
+def heat_ceiling() -> float:
+    """Above this an EC volume is hot enough to un-EC (or replica-boost)."""
+    import os
+
+    from ..util.parsers import tolerant_ufloat
+
+    return tolerant_ufloat(os.environ.get("SWEED_HEAT_CEILING", ""), 50.0)
+
+
+def tier_floor() -> float:
+    """Below this a volume is cold enough for the S3 tier (must be below
+    heat_floor to mean anything)."""
+    import os
+
+    from ..util.parsers import tolerant_ufloat
+
+    return tolerant_ufloat(os.environ.get("SWEED_TIER_FLOOR", ""), 0.005)
+
+
+def classify_heat(
+    heat: float,
+    floor: Optional[float] = None,
+    ceiling: Optional[float] = None,
+    cold: Optional[float] = None,
+) -> str:
+    """Heat value → band name: "hot" | "warm" | "cool" | "cold"."""
+    floor = heat_floor() if floor is None else floor
+    ceiling = heat_ceiling() if ceiling is None else ceiling
+    cold = tier_floor() if cold is None else cold
+    if heat > ceiling:
+        return "hot"
+    if heat >= floor:
+        return "warm"
+    if heat >= cold:
+        return "cool"
+    return "cold"
+
+
 class VolumeLayout:
     def __init__(
         self,

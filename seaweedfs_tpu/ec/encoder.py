@@ -729,9 +729,21 @@ def write_sorted_file_from_idx(
 
 
 # -- .vif volume info --------------------------------------------------------
-def save_volume_info(file_name: str, version: int = 3, replication: str = "") -> None:
-    """jsonpb-style VolumeInfo (pb/volume_info.go:56 SaveVolumeInfo)."""
+def save_volume_info(
+    file_name: str,
+    version: int = 3,
+    replication: str = "",
+    shard_sums: "list[str] | None" = None,
+) -> None:
+    """jsonpb-style VolumeInfo (pb/volume_info.go:56 SaveVolumeInfo).
+
+    ``shard_sums`` (sha256 hex per shard id, written at encode time) gives
+    the background scrub a ground truth for shard integrity: RS encoding is
+    deterministic, so a rebuilt shard hashes identically and the sums stay
+    valid across rebuilds and copies (the .vif travels with the shards)."""
     info = {"files": [], "version": version, "replication": replication}
+    if shard_sums is not None:
+        info["shard_sums"] = shard_sums
     with open(file_name, "w") as f:
         f.write(json.dumps(info, indent=2))
 

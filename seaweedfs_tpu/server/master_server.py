@@ -14,6 +14,7 @@ Endpoint map (reference handler → here):
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import urllib.parse
@@ -104,6 +105,33 @@ class MasterServer:
             get_max_volume_id=lambda: self.master.topo.max_volume_id + vid_margin,
             on_volume_id_checkpoint=self.master.topo.checkpoint_max_volume_id,
             state_path=state_path,
+        )
+        # lifecycle autopilot (cluster/lifecycle.py): leader-only
+        # observe→plan→execute over the heat-annotated topology. Always
+        # constructed (so /lifecycle/status answers and recovery state is
+        # inspectable), the loop only runs with SWEED_LIFECYCLE=1.
+        from ..cluster.lifecycle import (
+            ClusterOps,
+            LifecycleConfig,
+            LifecycleController,
+            observe_topology,
+        )
+
+        self.lifecycle_enabled = os.environ.get("SWEED_LIFECYCLE") == "1"
+        lcfg = LifecycleConfig.from_env()
+        journal = None
+        if meta_dir:
+            import os as _os
+
+            journal = _os.path.join(meta_dir, f"lifecycle_{port}.json")
+        self.lifecycle = LifecycleController(
+            journal_path=journal,
+            config=lcfg,
+            observe=lambda: observe_topology(self),
+            ops=ClusterOps(f"{host}:{port}", lcfg),
+            is_leader=lambda: self.election.is_leader,
+            lease=lambda client: self.master.lease_admin_token(client),
+            release=self.master.release_admin_token,
         )
 
     # -- volume allocation via volume server admin endpoint ------------------
@@ -278,7 +306,26 @@ class MasterServer:
             # assign latency quantiles from the cumulative-bucket histogram
             "assign": self._assign_hist.summary(op="assign"),
             "trace": trace.trace_stats(),
+            # lifecycle autopilot: cycle counters, interlock state, recovery
+            "lifecycle": {
+                "enabled": self.lifecycle_enabled,
+                **self.lifecycle.status(),
+            },
         }
+
+    # -- lifecycle autopilot (cluster/lifecycle.py) --------------------------
+    def _h_lifecycle_status(self, h, path, q, body):
+        st = self.lifecycle.status()
+        st["enabled"] = self.lifecycle_enabled
+        return 200, st
+
+    def _h_lifecycle_pause(self, h, path, q, body):
+        self.lifecycle.pause()
+        return 200, {"paused": True}
+
+    def _h_lifecycle_resume(self, h, path, q, body):
+        self.lifecycle.resume()
+        return 200, {"paused": False}
 
     # -- fleet EC scheduling (cluster/fleet.py) ------------------------------
     def _h_fleet_encode(self, h, path, q, body):
@@ -443,6 +490,14 @@ class MasterServer:
                 ("POST", "/ec/fleet/rebuild",
                  ms._leader_only(ms._h_fleet_rebuild)),
                 ("GET", "/ec/fleet/status", ms._leader_only(ms._h_fleet_status)),
+                # lifecycle autopilot: only the leader runs the loop, so
+                # pause/resume/status must land on (or proxy to) it
+                ("GET", "/lifecycle/status",
+                 ms._leader_only(ms._h_lifecycle_status)),
+                ("POST", "/lifecycle/pause",
+                 ms._leader_only(ms._h_lifecycle_pause)),
+                ("POST", "/lifecycle/resume",
+                 ms._leader_only(ms._h_lifecycle_resume)),
                 # reads proxy too: only the leader's topology is fed by
                 # heartbeats, so followers answer through it (the reference
                 # wraps these handlers in proxyToLeader as well)
@@ -468,11 +523,14 @@ class MasterServer:
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
         self.election.start()
+        if self.lifecycle_enabled:
+            self.lifecycle.start()
         return self
 
     def stop(self):
         self._stop.set()
         self.election.stop()
+        self.lifecycle.stop()
         self.fleet.stop()
         if self._srv:
             self._srv.shutdown()

@@ -810,7 +810,10 @@ class VolumeServer:
         return 200, {"results": results}
 
     def _h_delete_volume(self, h, path, q, body):
-        ok = self.store.delete_volume(_q_req_uint(q, "volume"))
+        vid = _q_req_uint(q, "volume")
+        ok = self.store.delete_volume(vid)
+        if ok:
+            self.store.clear_corrupt(vid)
         return 200, {"deleted": ok}
 
     def _h_readonly(self, h, path, q, body):
@@ -884,6 +887,9 @@ class VolumeServer:
         from ..ec.ec_volume import rebuild_ecx_file
 
         rebuild_ecx_file(base)
+        # rebuilt shards are fresh bytes: drop any scrub findings so the
+        # heartbeat stops advertising them and the next round re-validates
+        self.store.clear_corrupt(vid, shard_ids=generated)
         return 200, {"rebuilt_shards": generated}
 
     def _h_ec_copy(self, h, path, q, body):
@@ -916,6 +922,8 @@ class VolumeServer:
 
             atomic_write(base + ext, data)
             copied.append(ext)
+        # re-fetched shard bytes supersede any scrub findings on them
+        self.store.clear_corrupt(vid, shard_ids=shard_ids)
         return 200, {"copied": copied}
 
     def _h_file(self, h, path, q, body):
@@ -957,6 +965,8 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             return 500, {"error": "volume copied but failed to load"}
+        # a fresh replica supersedes any scrub findings on the old bytes
+        self.store.clear_corrupt(vid)
         # instant delta beat (volume_grpc_client_to_master.go:155): the
         # heartbeat loop wakes on delta_event and reports the new volume
         # without waiting out the pulse
@@ -1116,6 +1126,7 @@ class VolumeServer:
                     if shard:
                         shard.close()
         if removed:
+            self.store.clear_corrupt(vid, shard_ids=removed)
             self.store.queue_deleted_ec_shards(
                 vid, collection, sum(1 << s for s in removed)
             )
@@ -1286,6 +1297,7 @@ class VolumeServer:
         sweed_scrub_crc_errors_total instead of never."""
         rate = max(1, tolerant_uint(os.environ.get("SWEED_SCRUB_RATE"), 32))
         cursors: dict[int, int] = {}  # vid → next .dat offset to verify
+        ec_cursors: dict[int, int] = {}  # vid → next shard slot to hash
         while not self._stop.is_set():
             vols = [
                 v
@@ -1297,17 +1309,77 @@ class VolumeServer:
                     return
                 try:
                     cursors[v.id] = self._scrub_volume_step(
-                        v, cursors.get(v.id, 0), rate
+                        v,
+                        cursors.get(v.id, 0),
+                        rate,
+                        report=self.store.report_corrupt_needle,
                     )
                 except Exception as e:  # noqa: BLE001
                     # compaction/unmount shifted the ground under the
                     # cursor; restart this volume from the front
                     glog.warning("scrub vid %d reset: %s", v.id, e)
                     cursors[v.id] = 0
+            ecs = [
+                ev
+                for loc in self.store.locations
+                for ev in list(loc.ec_volumes.values())
+            ]
+            for ev in ecs:
+                if self._stop.is_set():
+                    return
+                try:
+                    ec_cursors[ev.id] = self._scrub_ec_step(
+                        ev,
+                        ec_cursors.get(ev.id, 0),
+                        report=self.store.report_corrupt_shard,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    glog.warning("scrub ec vid %d reset: %s", ev.id, e)
+                    ec_cursors[ev.id] = 0
             self._stop.wait(1.0)
 
     @staticmethod
-    def _scrub_volume_step(v, offset: int, budget: int) -> int:
+    def _scrub_ec_step(ev, cursor: int, report=None) -> int:
+        """Hash at most one local shard of one EC volume against the sha256
+        sums the encoder wrote into the .vif (ec/encoder.py) and report a
+        mismatch to the store's corrupt-shard registry, where it rides the
+        next heartbeat to the master's lifecycle controller for a fleet
+        rebuild. Returns the next shard slot to try (0 = wrapped)."""
+        import hashlib
+
+        from ..ec import encoder
+        from ..ec.constants import shard_ext
+        from ..stats import SCRUB_COUNTERS
+
+        sums = encoder.load_volume_info(ev.base_file_name + ".vif").get(
+            "shard_sums"
+        )
+        if not sums:
+            return 0  # pre-shard-sum encode: nothing to verify against
+        sids = ev.shard_ids()
+        for slot, sid in enumerate(sids):
+            if slot < cursor or sid >= len(sums):
+                continue
+            digest = hashlib.sha256()
+            total = 0
+            with open(ev.base_file_name + shard_ext(sid), "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    digest.update(chunk)
+                    total += len(chunk)
+            SCRUB_COUNTERS["checked"].inc()
+            SCRUB_COUNTERS["bytes"].inc(total)
+            if digest.hexdigest() != sums[sid]:
+                SCRUB_COUNTERS["errors"].inc()
+                glog.warning(
+                    "scrub: shard hash mismatch vid %d shard %d", ev.id, sid
+                )
+                if report is not None:
+                    report(ev.id, sid)
+            return slot + 1 if slot + 1 < len(sids) else 0
+        return 0
+
+    @staticmethod
+    def _scrub_volume_step(v, offset: int, budget: int, report=None) -> int:
         """Verify up to ``budget`` live needles of one volume starting at
         ``offset``; returns the cursor for the next step (0 = wrapped)."""
         from ..stats import SCRUB_COUNTERS
@@ -1340,6 +1412,10 @@ class VolumeServer:
                         "scrub: CRC mismatch vid %d needle %d @%d",
                         v.id, nid, offset,
                     )
+                    if report is not None:
+                        # registry entry rides the heartbeat; the master's
+                        # lifecycle controller schedules the replica re-fetch
+                        report(v.id, nid)
                 SCRUB_COUNTERS["checked"].inc()
                 SCRUB_COUNTERS["bytes"].inc(total)
                 checked += 1

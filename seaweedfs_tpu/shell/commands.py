@@ -114,6 +114,33 @@ def cluster_status(env: CommandEnv) -> dict:
     return env.topology()
 
 
+# -- lifecycle autopilot (cluster/lifecycle.py) -------------------------------
+def lifecycle_status(env: CommandEnv) -> dict:
+    """lifecycle.status: the controller's cycle counters, interlock state,
+    last plan, and journal recovery summary (leader answers; followers
+    proxy)."""
+    r = http_json("GET", f"http://{env.master}/lifecycle/status")
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return r
+
+
+def lifecycle_pause(env: CommandEnv) -> dict:
+    """lifecycle.pause: stop scheduling new actions (in-flight ones
+    finish — they are staged-commit protected either way)."""
+    r = http_json("POST", f"http://{env.master}/lifecycle/pause")
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return r
+
+
+def lifecycle_resume(env: CommandEnv) -> dict:
+    r = http_json("POST", f"http://{env.master}/lifecycle/resume")
+    if r.get("error"):
+        raise RuntimeError(r["error"])
+    return r
+
+
 def trace_collect(env: CommandEnv, trace_id: str) -> dict:
     """Assemble one distributed trace from every daemon's /debug/traces
     ring (weed shell has no analog; this is the Dapper-style collector
@@ -573,6 +600,8 @@ def volume_tier_upload(
             f"&bucket={bucket}&keepLocal={'true' if keep_local else 'false'}"
             f"&skipUpload={'true' if i > 0 else 'false'}&backend={backend}",
         )
+        if r.get("error"):
+            raise RuntimeError(f"tier upload {vid} on {loc}: {r['error']}")
         results.append({"server": loc} | r)
     return {"tiered": results}
 
@@ -584,6 +613,8 @@ def volume_tier_download(env: CommandEnv, vid: int) -> dict:
     results = []
     for loc in locs:
         r = http_json("POST", f"http://{loc}/admin/tier_download?volume={vid}")
+        if r.get("error"):
+            raise RuntimeError(f"tier download {vid} on {loc}: {r['error']}")
         results.append({"server": loc} | r)
     return {"downloaded": results}
 
@@ -808,11 +839,28 @@ def volume_balance(
     else:
         plan = _balance_plan(volume_list(env), env.data_nodes(), collection)
     moved = []
+    skipped = []
     if apply:
         for m in plan:
-            volume_move(env, m["vid"], m["to"], m["from"])
+            # re-validate against FRESH heartbeat state at execution time:
+            # the plan was computed over a snapshot, and an earlier move in
+            # this very loop (or a node death) can invalidate later entries —
+            # a move whose source or target died must be skipped, not
+            # exploded on (the next balance run replans from live state)
+            live = {n["url"] for n in env.data_nodes()}
+            locs = env.volume_locations(m["vid"])
+            if m["from"] not in live or m["to"] not in live:
+                skipped.append({**m, "reason": "source or target node died"})
+                continue
+            if m["from"] not in locs:
+                skipped.append({**m, "reason": f"{m['from']} no longer holds volume"})
+                continue
+            if m["to"] in locs:
+                skipped.append({**m, "reason": f"{m['to']} already holds volume"})
+                continue
+            volume_move(env, m["vid"], m["to"], m["from"])  # sweedlint: ok maintenance-without-interlock operator-invoked one-shot rebalance; the operator holding the admin lock is the interlock
             moved.append(m)
-    return {"plan": plan, "moved": moved}
+    return {"plan": plan, "moved": moved, "skipped": skipped}
 
 
 def volume_server_evacuate(
@@ -838,7 +886,7 @@ def volume_server_evacuate(
         if not targets:
             raise RuntimeError(f"no target free of volume {vid}")
         if apply:
-            volume_move(env, vid, targets[0], server)
+            volume_move(env, vid, targets[0], server)  # sweedlint: ok maintenance-without-interlock operator-driven drain of a retiring node; pausing on load would strand the evacuation half done
         counts[targets[0]] += 1
         moves.append({"vid": vid, "to": targets[0]})
     for s in st.get("ec", []):

@@ -47,6 +47,10 @@ HELP = """commands:
   trace TRACE_ID          assemble one distributed trace (filer→assign→
                  volume span tree with per-hop timings) from every
                  daemon's /debug/traces ring
+  lifecycle.status        cycle counters, interlock state, last plan, and
+                 journal recovery summary of the master's lifecycle autopilot
+  lifecycle.pause | lifecycle.resume  halt / restart autopilot scheduling
+                 (in-flight actions finish; they are staged-commit safe)
   lock | unlock
   help | exit
 """
@@ -71,7 +75,7 @@ def _flags(parts: list[str]) -> dict[str, str]:
 _RETRY_SAFE = {
     "help", "cluster.status", "volume.list", "collection.list",
     "bucket.list", "fs.ls", "fs.du", "fs.tree", "fs.cat", "fs.pwd",
-    "fs.meta.cat", "query", "trace",
+    "fs.meta.cat", "query", "trace", "lifecycle.status",
 }
 
 
@@ -211,6 +215,12 @@ def run_command(env: CommandEnv, line: str) -> object:
         return C.bucket_delete(env, flags["name"])
     if cmd == "cluster.status":
         return C.cluster_status(env)
+    if cmd == "lifecycle.status":
+        return C.lifecycle_status(env)
+    if cmd == "lifecycle.pause":
+        return C.lifecycle_pause(env)
+    if cmd == "lifecycle.resume":
+        return C.lifecycle_resume(env)
     if cmd == "volume.list":
         return C.volume_list(env)
     if cmd == "volume.vacuum":

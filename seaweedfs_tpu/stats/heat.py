@@ -16,15 +16,21 @@ heat is only accounted on the Python path — heat-aware deployments run
 
 from __future__ import annotations
 
+import os
 import time
 import weakref
 
 from ..util.locks import make_lock
+from ..util.parsers import tolerant_ufloat
 
 # one half-life of inactivity halves a volume's heat: long enough that a
 # rebalance sees a stable ranking, short enough that yesterday's storm
-# doesn't pin today's placement
-HEAT_HALFLIFE_SECONDS = 60.0
+# doesn't pin today's placement. SWEED_HEAT_HALFLIFE (seconds) overrides —
+# the lifecycle probe/chaos tests shrink it so cooling is observable in
+# seconds instead of minutes.
+HEAT_HALFLIFE_SECONDS = tolerant_ufloat(
+    os.environ.get("SWEED_HEAT_HALFLIFE", ""), 60.0
+) or 60.0
 
 
 class EwmaHeat:

@@ -439,6 +439,63 @@ def register_sync_metrics(registry: Optional[Registry] = None) -> None:
 register_sync_metrics()
 
 
+def register_lifecycle_metrics(registry: Optional[Registry] = None) -> None:
+    """Gauges over the master's lifecycle controller (cluster/lifecycle.py):
+    cycle/action counters plus the safety-interlock tallies. Cycle and
+    per-action latency quantiles live in the sweed_lifecycle_*_seconds
+    histograms the controller module owns."""
+
+    def _snap(key):
+        from ..cluster.lifecycle import lifecycle_stats
+
+        return lifecycle_stats().get(key, 0)
+
+    reg = registry if registry is not None else default_registry
+    reg.gauge(
+        "sweed_lifecycle_controllers",
+        "live lifecycle controllers in this process",
+    ).set_function(lambda: _snap("controllers"))
+    reg.gauge(
+        "sweed_lifecycle_paused",
+        "controllers currently paused by an operator",
+    ).set_function(lambda: _snap("paused"))
+    reg.gauge(
+        "sweed_lifecycle_cycles_total",
+        "observe→plan→execute cycles started",
+    ).set_function(lambda: _snap("cycles"))
+    reg.gauge(
+        "sweed_lifecycle_actions_done_total",
+        "lifecycle actions executed to completion",
+    ).set_function(lambda: _snap("actions_done"))
+    reg.gauge(
+        "sweed_lifecycle_actions_failed_total",
+        "lifecycle actions that errored",
+    ).set_function(lambda: _snap("actions_failed"))
+    reg.gauge(
+        "sweed_lifecycle_actions_deferred_total",
+        "actions deferred because the load interlock saw a traffic peak",
+    ).set_function(lambda: _snap("actions_deferred"))
+    reg.gauge(
+        "sweed_lifecycle_cycles_deferred_total",
+        "whole cycles deferred by the load interlock",
+    ).set_function(lambda: _snap("cycles_deferred"))
+    reg.gauge(
+        "sweed_lifecycle_cycles_skipped_locked_total",
+        "cycles skipped because a shell held the cluster admin lock",
+    ).set_function(lambda: _snap("cycles_skipped_locked"))
+    reg.gauge(
+        "sweed_lifecycle_recovered_resumed_total",
+        "journal-replay actions re-validated and re-executed after failover",
+    ).set_function(lambda: _snap("resumed"))
+    reg.gauge(
+        "sweed_lifecycle_recovered_abandoned_total",
+        "journal-replay actions abandoned (never started before the crash)",
+    ).set_function(lambda: _snap("abandoned"))
+
+
+register_lifecycle_metrics()
+
+
 def register_scrub_metrics(
     registry: Optional[Registry] = None,
 ) -> dict[str, Counter]:

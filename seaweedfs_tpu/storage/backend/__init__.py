@@ -1,0 +1,21 @@
+"""Backend storage package: local + remote IO under a volume's .dat.
+
+Split along the reference's `weed/storage/backend/` layout:
+    core.py        BackendStorageFile / DiskFile / MemoryFile
+    s3_backend.py  S3BackendStorage + RemoteS3File (the cold tier)
+    fake_s3.py     directory-backed fake-S3 server for tests/probes
+
+The historical import surface (`from ..storage.backend import DiskFile`)
+is preserved here.
+"""
+
+from .core import BackendStorageFile, DiskFile, MemoryFile
+from .s3_backend import RemoteS3File, S3BackendStorage
+
+__all__ = [
+    "BackendStorageFile",
+    "DiskFile",
+    "MemoryFile",
+    "RemoteS3File",
+    "S3BackendStorage",
+]

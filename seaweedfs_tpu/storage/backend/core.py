@@ -2,8 +2,8 @@
 
 Mirrors `weed/storage/backend/backend.go:15-25` (BackendStorageFile):
 read_at/write_at/truncate/close/size/name/sync. DiskFile wraps a local file;
-MemoryFile supports tests and scratch volumes. A remote/S3-tier backend slots
-in here later (backend/s3_backend/s3_backend.go).
+MemoryFile supports tests and scratch volumes. The remote S3 tier lives in
+the sibling module (s3_backend.py — backend/s3_backend/s3_backend.go).
 """
 
 from __future__ import annotations
@@ -11,8 +11,7 @@ from __future__ import annotations
 import os
 import threading
 
-from ..util.parsers import tolerant_uint
-from ..util.locks import make_lock
+from ...util.locks import make_lock
 
 
 class BackendStorageFile:
@@ -147,61 +146,3 @@ class MemoryFile(BackendStorageFile):
 
     def name(self) -> str:
         return self._name
-
-
-class RemoteS3File(BackendStorageFile):
-    """Read-only .dat served from an S3-compatible endpoint via ranged GETs
-    (backend/s3_backend/s3_backend.go:33,117,152: ReadAt → ranged GET,
-    size from HEAD). Writes are invalid — tiered volumes are sealed."""
-
-    def __init__(
-        self,
-        endpoint: str,
-        bucket: str,
-        key: str,
-        access_key: str = "",
-        secret_key: str = "",
-        size: int = -1,
-    ):
-        from ..s3api.s3_client import S3Client
-
-        self.client = S3Client(endpoint, access_key, secret_key)
-        self.bucket, self.key = bucket, key
-        self._size = size
-        if self._size < 0:
-            status, _, headers = self.client.head_object(bucket, key)
-            if status != 200:
-                raise FileNotFoundError(f"s3://{bucket}/{key}: HTTP {status}")
-            self._size = tolerant_uint(headers.get("Content-Length", 0), 0)
-
-    def read_at(self, offset: int, size: int) -> bytes:
-        if size <= 0 or offset >= self._size:
-            return b""
-        end = min(offset + size, self._size) - 1
-        status, data, _ = self.client.get_object(
-            self.bucket, self.key, rng=f"bytes={offset}-{end}"
-        )
-        if status not in (200, 206):
-            raise IOError(f"s3 ranged read {self.key}@{offset}: HTTP {status}")
-        return data
-
-    def write_at(self, offset: int, data: bytes) -> int:
-        raise IOError("remote-tier volume is read only")
-
-    def append(self, data: bytes) -> int:
-        raise IOError("remote-tier volume is read only")
-
-    def truncate(self, size: int) -> None:
-        raise IOError("remote-tier volume is read only")
-
-    def size(self) -> int:
-        return self._size
-
-    def name(self) -> str:
-        return f"s3://{self.bucket}/{self.key}"
-
-    def sync(self) -> None:
-        pass
-
-    def close(self) -> None:
-        pass

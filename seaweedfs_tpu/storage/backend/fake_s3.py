@@ -1,0 +1,239 @@
+"""Directory-backed fake-S3 server for tier tests and the lifecycle probe.
+
+The reference tests its s3_backend against localstack-style stand-ins; this
+is the minimal equivalent: just enough of the S3 REST surface for
+``S3BackendStorage`` / ``RemoteS3File`` (and the underlying
+``s3api.s3_client.S3Client``) to tier volumes against it —
+
+    PUT    /bucket                      create bucket
+    PUT    /bucket/key                  put object
+    POST   /bucket/key?uploads          initiate multipart → UploadId
+    PUT    /bucket/key?partNumber&uploadId   upload part
+    POST   /bucket/key?uploadId         complete multipart (concatenate)
+    DELETE /bucket/key?uploadId         abort multipart
+    GET    /bucket/key [Range: bytes=a-b]    (ranged) get object
+    HEAD   /bucket/key                  size probe
+    DELETE /bucket[/key]                delete
+
+Objects live as plain files under ``root/bucket/key`` so tests can corrupt
+or inspect the cold tier directly. SigV4 Authorization headers are accepted
+and ignored — signing is the client's concern; this server only fakes
+storage semantics. Deliberately NOT the full ``s3api.S3ApiServer`` (which
+needs a filer): the cold tier must be mountable in a unit test with nothing
+else running.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ...util.parsers import parse_ascii_uint, tolerant_uint
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "FakeS3/0.1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    # -- helpers -------------------------------------------------------------
+    def _split(self):
+        parsed = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+        parts = parsed.path.strip("/").split("/", 1)
+        bucket = parts[0] if parts[0] else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return bucket, key, q
+
+    def _obj_path(self, bucket: str, key: str) -> str:
+        # keys stay flat (collection_vid.dat); refuse traversal outright
+        safe = key.replace("/", "_").replace("..", "_")
+        return os.path.join(self.server.root, bucket, safe)
+
+    def _reply(self, status: int, body: bytes = b"", headers=None):
+        self.send_response(status)
+        hdrs = dict(headers or {})
+        for k, v in hdrs.items():
+            self.send_header(k, v)
+        # HEAD advertises the object size explicitly; emitting a second
+        # Content-Length (the empty body's 0) makes strict clients see a
+        # joined "N, 0" header and mis-size the download
+        if not any(k.lower() == "content-length" for k in hdrs):
+            self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.command != "HEAD" and body:
+            self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = tolerant_uint(self.headers.get("Content-Length", "0"), 0)
+        body = self.rfile.read(n) if n else b""
+        # aws-chunked framing (streaming SigV4): strip the chunk envelope
+        if b";chunk-signature=" in body[:200]:
+            out, rest = bytearray(), body
+            while rest:
+                head, _, rest = rest.partition(b"\r\n")
+                size = int(head.split(b";")[0], 16)
+                if size == 0:
+                    break
+                out += rest[:size]
+                rest = rest[size + 2:]
+            return bytes(out)
+        return body
+
+    # -- verbs ---------------------------------------------------------------
+    def do_PUT(self):
+        bucket, key, q = self._split()
+        if not bucket:
+            return self._reply(400)
+        bdir = os.path.join(self.server.root, bucket)
+        if not key:  # create bucket
+            os.makedirs(bdir, exist_ok=True)
+            return self._reply(200)
+        if not os.path.isdir(bdir):
+            return self._reply(404, b"<Error><Code>NoSuchBucket</Code></Error>")
+        body = self._read_body()
+        if "partNumber" in q and "uploadId" in q:
+            try:
+                pn = parse_ascii_uint(q["partNumber"])
+            except ValueError:
+                return self._reply(400)
+            with self.server.lock:
+                parts = self.server.uploads.get(q["uploadId"])
+                if parts is None:
+                    return self._reply(404)
+                parts[pn] = body
+            return self._reply(200, headers={"ETag": f'"{len(body):x}"'})
+        with open(self._obj_path(bucket, key), "wb") as f:
+            f.write(body)
+        return self._reply(200, headers={"ETag": '"fake"'})
+
+    def do_POST(self):
+        bucket, key, q = self._split()
+        if "uploads" in q:  # initiate multipart
+            uid = uuid.uuid4().hex
+            with self.server.lock:
+                self.server.uploads[uid] = {}
+                self.server.upload_keys[uid] = (bucket, key)
+            return self._reply(
+                200, f"<InitiateMultipartUploadResult><UploadId>{uid}"
+                     f"</UploadId></InitiateMultipartUploadResult>".encode())
+        if "uploadId" in q:  # complete multipart
+            self._read_body()
+            with self.server.lock:
+                parts = self.server.uploads.pop(q["uploadId"], None)
+                self.server.upload_keys.pop(q["uploadId"], None)
+            if parts is None:
+                return self._reply(404)
+            with open(self._obj_path(bucket, key), "wb") as f:
+                for num in sorted(parts):
+                    f.write(parts[num])
+            return self._reply(
+                200, b"<CompleteMultipartUploadResult/>")
+        return self._reply(400)
+
+    def do_GET(self):
+        bucket, key, _ = self._split()
+        if not bucket:
+            return self._reply(200, b"<ListAllMyBucketsResult/>")
+        path = self._obj_path(bucket, key) if key else ""
+        if not key or not os.path.isfile(path):
+            return self._reply(404, b"<Error><Code>NoSuchKey</Code></Error>")
+        size = os.path.getsize(path)
+        rng = self.headers.get("Range", "")
+        m = re.match(r"bytes=(\d+)-(\d*)$", rng)
+        with open(path, "rb") as f:
+            if m:
+                start = int(m.group(1))
+                end = int(m.group(2)) if m.group(2) else size - 1
+                end = min(end, size - 1)
+                if start > end:
+                    return self._reply(416)
+                f.seek(start)
+                data = f.read(end - start + 1)
+                return self._reply(206, data, headers={
+                    "Content-Range": f"bytes {start}-{end}/{size}",
+                })
+            return self._reply(200, f.read())
+
+    def do_HEAD(self):
+        bucket, key, _ = self._split()
+        path = self._obj_path(bucket, key) if bucket and key else ""
+        if not path or not os.path.isfile(path):
+            return self._reply(404)
+        return self._reply(
+            200, headers={"Content-Length": str(os.path.getsize(path))}
+        )
+
+    def do_DELETE(self):
+        bucket, key, q = self._split()
+        if "uploadId" in q:  # abort multipart
+            with self.server.lock:
+                self.server.uploads.pop(q["uploadId"], None)
+                self.server.upload_keys.pop(q["uploadId"], None)
+            return self._reply(204)
+        if bucket and key:
+            try:
+                # sweedlint: ok durability fake-S3 object store under the test root, not the volume data plane; S3 DeleteObject has no staged-commit semantics to preserve
+                os.unlink(self._obj_path(bucket, key))
+            except FileNotFoundError:
+                pass
+            return self._reply(204)
+        if bucket:
+            import shutil
+
+            shutil.rmtree(
+                os.path.join(self.server.root, bucket), ignore_errors=True
+            )
+            return self._reply(204)
+        return self._reply(400)
+
+
+class FakeS3Server:
+    """``with FakeS3Server(root) as s3: ... s3.endpoint ...``"""
+
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0):
+        os.makedirs(root, exist_ok=True)
+        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self._srv.daemon_threads = True
+        self._srv.root = root
+        self._srv.lock = threading.Lock()
+        self._srv.uploads = {}       # uploadId → {partNumber: bytes}
+        self._srv.upload_keys = {}   # uploadId → (bucket, key)
+        self._thread: threading.Thread | None = None
+        self.root = root
+        self.host, self.port = self._srv.server_address[:2]
+        self.endpoint = f"http://{self.host}:{self.port}"
+
+    def start(self) -> "FakeS3Server":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self) -> "FakeS3Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def object_path(self, bucket: str, key: str) -> str:
+        return os.path.join(self.root, bucket, key.replace("/", "_"))
+
+    def bytes_stored(self) -> int:
+        """Total object bytes on the fake backend (probe tier accounting)."""
+        total = 0
+        for dirpath, _, files in os.walk(self.root):
+            for fn in files:
+                total += os.path.getsize(os.path.join(dirpath, fn))
+        return total

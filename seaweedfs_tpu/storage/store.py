@@ -82,6 +82,16 @@ class Store:
         self.new_ec_shards: deque[dict] = deque()
         self.deleted_ec_shards: deque[dict] = deque()
         self.delta_event = threading.Event()
+        # EC volumes have no Volume.read_heat — their read heat lives here,
+        # marked on the EC needle-read path and shipped in the EC heartbeat
+        # so the lifecycle controller can spot hot EC volumes to un-EC
+        self.ec_read_heat: dict[int, heat.EwmaHeat] = {}
+        # scrub findings (SWEED_SCRUB): corrupt needle/shard ids per vid,
+        # carried in heartbeats so the master-resident lifecycle controller
+        # can schedule a rebuild / replica re-fetch; cleared when the local
+        # copy is deleted, re-copied, or rebuilt
+        self.corrupt_needles: dict[int, set[int]] = {}
+        self.corrupt_shards: dict[int, set[int]] = {}
         self._lock = make_rlock("Store._lock")
         heat.register_store(self)
 
@@ -332,10 +342,22 @@ class Store:
         try:
             encoder.write_ec_files(base, self.ec_codec, suffix=".tmp")
             encoder.write_sorted_file_from_idx(base, ext=".ecx.tmp")
+            # per-shard sha256 into the .vif: the scrub thread's integrity
+            # ground truth (RS is deterministic — rebuilds hash identically)
+            import hashlib
+
+            sums = []
+            for sid in range(TOTAL_SHARDS):
+                digest = hashlib.sha256()
+                with open(base + shard_ext(sid) + ".tmp", "rb") as sf:
+                    for chunk in iter(lambda: sf.read(1 << 20), b""):
+                        digest.update(chunk)
+                sums.append(digest.hexdigest())
             encoder.save_volume_info(
                 vif_tmp,
                 version=v.version,
                 replication=str(v.super_block.replica_placement),
+                shard_sums=sums,
             )
             sc.commit()
         except BaseException:
@@ -343,8 +365,43 @@ class Store:
             raise
         return list(range(TOTAL_SHARDS))
 
+    # -- scrub findings (consumed by cluster/lifecycle.py via heartbeats) ----
+    def report_corrupt_needle(self, vid: int, nid: int) -> None:
+        with self._lock:
+            found = self.corrupt_needles.setdefault(vid, set())
+            if nid in found:
+                return  # already flagged: don't re-trigger delta beats
+            found.add(nid)
+        self.delta_event.set()  # instant beat: repair shouldn't wait a pulse
+
+    def report_corrupt_shard(self, vid: int, sid: int) -> None:
+        with self._lock:
+            found = self.corrupt_shards.setdefault(vid, set())
+            if sid in found:
+                return
+            found.add(sid)
+        self.delta_event.set()
+
+    def clear_corrupt(self, vid: int, shard_ids=None) -> None:
+        """Forget scrub findings for a vid — the local copy was deleted,
+        re-fetched, or rebuilt; the next scrub round re-validates."""
+        with self._lock:
+            self.corrupt_needles.pop(vid, None)
+            if shard_ids is None:
+                self.corrupt_shards.pop(vid, None)
+            else:
+                left = self.corrupt_shards.get(vid)
+                if left is not None:
+                    left -= set(shard_ids)
+                    if not left:
+                        self.corrupt_shards.pop(vid, None)
+
     # -- EC read path (store_ec.go:122-375) ----------------------------------
     def read_ec_shard_needle(self, ev: EcVolume, n: Needle) -> int:
+        h = self.ec_read_heat.get(ev.id)
+        if h is None:
+            h = self.ec_read_heat.setdefault(ev.id, heat.EwmaHeat())
+        h.mark()
         offset, size, intervals = ev.locate_needle(n.id)
         blob = b"".join(self._read_interval(ev, iv) for iv in intervals)
         m = Needle.from_bytes(blob, size, ev.version)
@@ -440,8 +497,7 @@ class Store:
         return rebuilt[missing_shard].tobytes()
 
     # -- heartbeat (store.go:204-297) ----------------------------------------
-    @staticmethod
-    def _volume_message(v: Volume) -> dict:
+    def _volume_message(self, v: Volume) -> dict:
         return {
             "id": v.id,
             "size": v.size(),
@@ -456,6 +512,9 @@ class Store:
             "compact_revision": v.super_block.compaction_revision,
             "read_heat": round(v.read_heat.value(), 3),
             "write_heat": round(v.write_heat.value(), 3),
+            # lifecycle inputs: where the bytes live + what scrub flagged
+            "remote_tier": v.is_tiered(),
+            "corrupt_needles": len(self.corrupt_needles.get(v.id, ())),
         }
 
     def collect_heartbeat(self) -> dict:
@@ -478,11 +537,16 @@ class Store:
         ec_shards = []
         for loc in self.locations:
             for ev in loc.ec_volumes.values():
+                h = self.ec_read_heat.get(ev.id)
                 ec_shards.append(
                     {
                         "id": ev.id,
                         "collection": ev.collection,
                         "ec_index_bits": sum(1 << sid for sid in ev.shard_ids()),
+                        "read_heat": round(h.value(), 3) if h else 0.0,
+                        "corrupt_shards": sorted(
+                            self.corrupt_shards.get(ev.id, ())
+                        ),
                     }
                 )
         return {"ip": self.ip, "port": self.port, "ec_shards": ec_shards}

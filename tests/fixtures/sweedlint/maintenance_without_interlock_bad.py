@@ -1,0 +1,12 @@
+"""Fixture: a daemon loop scheduling maintenance over volumes without
+consulting the load interlock — maintenance-without-interlock must fire
+exactly once."""
+
+
+def drain_cold_volumes(env, plan):
+    for move in plan:
+        volume_move(env, move["vid"], move["to"], move["from"])
+
+
+def volume_move(env, vid, target, source):
+    pass

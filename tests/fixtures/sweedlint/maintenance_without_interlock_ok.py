@@ -1,0 +1,20 @@
+"""Fixture: the sanctioned interlocked form plus a reasoned waiver —
+sweedlint must report nothing."""
+
+
+def drain_cold_volumes(env, plan, interlock):
+    for move in plan:
+        allowed, _reason = interlock.maintenance_allowed()
+        if not allowed:
+            break
+        volume_move(env, move["vid"], move["to"], move["from"])
+
+
+def evacuate_node(env, plan):
+    for move in plan:
+        # sweedlint: ok maintenance-without-interlock operator-driven one-shot drain; the operator is the interlock
+        volume_move(env, move["vid"], move["to"], move["from"])
+
+
+def volume_move(env, vid, target, source):
+    pass

@@ -1,0 +1,483 @@
+"""Lifecycle controller unit tests: simulated clock + heat injector.
+
+The controller is fully injectable (observe/ops/clock/interlock/lease),
+so these tests drive ``tick()`` synchronously against a tiny in-memory
+"world" dict and assert the planner's decisions, the interlocks, and the
+plan-journal replay semantics — no sockets, no disks beyond tmp_path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.cluster.lifecycle import (
+    LifecycleConfig,
+    LifecycleController,
+    LoadInterlock,
+    lifecycle_stats,
+)
+
+# Heat values pinned against the default bands (SWEED_HEAT_FLOOR=0.05,
+# SWEED_HEAT_CEILING=50, SWEED_TIER_FLOOR=0.005)
+HOT, WARM, COOL, COLD = 100.0, 1.0, 0.01, 0.001
+
+
+def make_vol(
+    vid,
+    heat=WARM,
+    kind="plain",
+    garbage=0.0,
+    size=1000,
+    tiered=False,
+    replicas=("n1:8080",),
+    corrupt_needles=None,
+    corrupt_shards=None,
+):
+    return {
+        "vid": vid,
+        "collection": "",
+        "kind": kind,
+        "heat": heat,
+        "garbage": garbage,
+        "size": size,
+        "replicas": list(replicas),
+        "tiered": tiered,
+        "read_only": False,
+        "corrupt_needles": dict(corrupt_needles or {}),
+        "ec_shards": {"n1:8080": list(range(14))} if kind == "ec" else {},
+        "corrupt_shards": dict(corrupt_shards or {}),
+    }
+
+
+class World:
+    """The heat injector: a mutable vid→volume map the fake observe
+    re-reads every cycle, with bands recomputed like observe_topology."""
+
+    def __init__(self, *vols):
+        self.vols = {v["vid"]: v for v in vols}
+
+    def observe(self):
+        from seaweedfs_tpu.cluster.volume_layout import classify_heat
+
+        obs = {}
+        for vid, v in self.vols.items():
+            ob = {k: (dict(val) if isinstance(val, dict) else
+                      list(val) if isinstance(val, list) else val)
+                  for k, val in v.items()}
+            ob["band"] = classify_heat(ob["heat"])
+            obs[vid] = ob
+        return obs
+
+
+class FakeOps:
+    """Executor that records actions and applies their effect to the
+    world, so the next observation sees the post-action state."""
+
+    def __init__(self, world, fail=()):
+        self.world = world
+        self.executed = []
+        self.fail = set(fail)
+
+    def execute(self, action, ob):
+        kind, vid = action["kind"], action["vid"]
+        self.executed.append((kind, vid))
+        if kind in self.fail:
+            raise RuntimeError(f"injected {kind} failure")
+        v = self.world.vols[vid]
+        if kind == "ec":
+            v["kind"] = "ec"
+            v["replicas"] = []
+        elif kind == "un_ec":
+            v["kind"] = "plain"
+            v["replicas"] = ["n1:8080"]
+        elif kind == "tier_up":
+            v["kind"] = "plain"
+            v["tiered"] = True
+        elif kind == "tier_down":
+            v["tiered"] = False
+        elif kind == "vacuum":
+            v["garbage"] = 0.0
+        elif kind == "repair_shard":
+            v["corrupt_shards"] = {}
+        elif kind == "repair_replica":
+            v["corrupt_needles"] = {}
+        elif kind == "replica_boost":
+            v["replicas"] = list(v["replicas"]) + ["n9:8080"]
+
+
+class FakeInterlock:
+    """Scripted interlock: pops the next verdict; sticks on the last."""
+
+    def __init__(self, *verdicts):
+        self.verdicts = list(verdicts) or [True]
+        self.fraction = 0.5
+        self.last_reason = ""
+        self.calls = 0
+
+    def maintenance_allowed(self):
+        self.calls += 1
+        v = (
+            self.verdicts.pop(0)
+            if len(self.verdicts) > 1
+            else self.verdicts[0]
+        )
+        self.last_reason = "" if v else "scripted traffic peak"
+        return v, self.last_reason
+
+
+@pytest.fixture
+def mk():
+    """Controller factory that unregisters from the module snapshot on
+    teardown so lifecycle_stats() never sees a dead test's counters."""
+    made = []
+
+    def build(world, cfg=None, ops=None, **kw):
+        kw.setdefault("interlock", FakeInterlock(True))
+        c = LifecycleController(
+            config=cfg or LifecycleConfig(),
+            observe=world.observe,
+            ops=ops if ops is not None else FakeOps(world),
+            **kw,
+        )
+        made.append(c)
+        return c
+
+    yield build
+    for c in made:
+        c.stop()
+
+
+# -- planning: heat bands drive the right transitions -------------------------
+
+def test_cooling_volume_ecs_exactly_once(mk):
+    world = World(make_vol(1, heat=COOL))
+    cfg = LifecycleConfig(cold_streak=2, cooldown_cycles=2)
+    c = mk(world, cfg)
+    for _ in range(6):
+        c.tick()
+    assert c.ops.executed == [("ec", 1)]
+    assert world.vols[1]["kind"] == "ec"
+
+
+def test_streak_gate_one_quiet_beat_is_not_cooling(mk):
+    """Heat dips for a single observation, then recovers: no EC."""
+    world = World(make_vol(1, heat=COOL))
+    c = mk(world, LifecycleConfig(cold_streak=3))
+    c.tick()  # streak 1
+    world.vols[1]["heat"] = WARM  # reheats before the streak completes
+    for _ in range(5):
+        c.tick()
+    assert c.ops.executed == []
+
+
+def test_reheated_ec_volume_un_ecs(mk):
+    world = World(make_vol(7, heat=HOT, kind="ec", replicas=()))
+    c = mk(world, LifecycleConfig())
+    c.tick()
+    assert c.ops.executed == [("un_ec", 7)]
+    assert world.vols[7]["kind"] == "plain"
+    # now plain and hot: nothing further (replica boost is disabled)
+    c.tick()
+    assert c.ops.executed == [("un_ec", 7)]
+
+
+def test_cold_volume_tiers_up_when_endpoint_configured(mk):
+    world = World(make_vol(3, heat=COLD))
+    cfg = LifecycleConfig(cold_streak=1, tier_endpoint="127.0.0.1:9333")
+    c = mk(world, cfg)
+    c.tick()
+    assert c.ops.executed == [("tier_up", 3)]
+    assert world.vols[3]["tiered"]
+
+
+def test_cold_volume_ecs_when_tier_disabled(mk):
+    world = World(make_vol(3, heat=COLD))
+    c = mk(world, LifecycleConfig(cold_streak=1))  # no tier_endpoint
+    c.tick()
+    assert c.ops.executed == [("ec", 3)]
+
+
+def test_tiered_volume_comes_home_when_warm(mk):
+    world = World(make_vol(4, heat=WARM, tiered=True))
+    c = mk(world, LifecycleConfig(tier_endpoint="127.0.0.1:9333"))
+    c.tick()
+    assert c.ops.executed == [("tier_down", 4)]
+    assert not world.vols[4]["tiered"]
+
+
+def test_vacuum_above_garbage_threshold(mk):
+    world = World(make_vol(5, heat=WARM, garbage=0.5))
+    c = mk(world, LifecycleConfig(garbage_threshold=0.3))
+    c.tick()
+    assert c.ops.executed == [("vacuum", 5)]
+    assert world.vols[5]["garbage"] == 0.0
+
+
+def test_repair_outranks_tiering(mk):
+    """One action slot, a corrupt EC volume and a cold one: repair wins."""
+    world = World(
+        make_vol(1, heat=COLD),
+        make_vol(
+            2, heat=WARM, kind="ec", replicas=(),
+            corrupt_shards={"n1:8080": [3]},
+        ),
+    )
+    cfg = LifecycleConfig(cold_streak=1, max_actions=1)
+    c = mk(world, cfg)
+    c.tick()
+    assert c.ops.executed == [("repair_shard", 2)]
+
+
+def test_repair_replica_refetches_from_healthy_peer(mk):
+    world = World(
+        make_vol(
+            6, heat=WARM, replicas=("n1:8080", "n2:8080"),
+            corrupt_needles={"n2:8080": 3},
+        )
+    )
+    c = mk(world, LifecycleConfig())
+    c.tick()
+    assert c.ops.executed == [("repair_replica", 6)]
+    assert world.vols[6]["corrupt_needles"] == {}
+
+
+def test_replica_boost_for_hot_volume(mk):
+    world = World(make_vol(8, heat=HOT))
+    c = mk(world, LifecycleConfig(hot_replicas=2))
+    c.tick()
+    assert c.ops.executed == [("replica_boost", 8)]
+    assert len(world.vols[8]["replicas"]) == 2
+    c.tick()  # target met: no further boost
+    assert c.ops.executed == [("replica_boost", 8)]
+
+
+def test_max_actions_and_budgets_bound_a_cycle(mk):
+    world = World(*[make_vol(v, heat=COOL) for v in range(1, 9)])
+    cfg = LifecycleConfig(
+        cold_streak=1, max_actions=4,
+        budgets={k: 0 for k in LifecycleConfig().budgets} | {"ec": 2},
+    )
+    c = mk(world, cfg)
+    c.tick()
+    assert len(c.ops.executed) == 2  # ec budget, below the global cap
+    assert all(k == "ec" for k, _ in c.ops.executed)
+
+
+def test_cooldown_prevents_flapping(mk):
+    """A just-vacuumed volume whose garbage immediately regrows must wait
+    out the cooldown before the next vacuum."""
+    world = World(make_vol(5, heat=WARM, garbage=0.9))
+    c = mk(world, LifecycleConfig(cooldown_cycles=3))
+    c.tick()
+    assert c.ops.executed == [("vacuum", 5)]
+    world.vols[5]["garbage"] = 0.9  # regrows instantly
+    c.tick()
+    c.tick()  # cycles 2,3: cooled down
+    assert c.ops.executed == [("vacuum", 5)]
+    c.tick()  # cycle 4: cooldown expired
+    assert c.ops.executed == [("vacuum", 5), ("vacuum", 5)]
+
+
+# -- interlocks ---------------------------------------------------------------
+
+def test_interlock_defers_whole_cycle(mk):
+    world = World(make_vol(5, heat=WARM, garbage=0.9))
+    c = mk(world, interlock=FakeInterlock(False))
+    s = c.tick()
+    assert c.ops.executed == []
+    assert s["deferred"]
+    assert c.status()["counters"]["cycles_deferred"] == 1
+    # traffic subsides: the deferred vacuum happens on the next cycle
+    c.interlock.verdicts = [True]
+    c.tick()
+    assert c.ops.executed == [("vacuum", 5)]
+
+
+def test_interlock_rechecked_before_every_action(mk):
+    """A traffic spike mid-cycle stops the remaining moves."""
+    world = World(
+        make_vol(1, heat=WARM, garbage=0.9),
+        make_vol(2, heat=WARM, garbage=0.9),
+    )
+    # cycle gate allows, first action allows, then the spike hits
+    c = mk(world, interlock=FakeInterlock(True, True, False))
+    c.tick()
+    assert c.ops.executed == [("vacuum", 1)]
+    st = c.status()["counters"]
+    assert st["actions_done"] == 1
+    assert st["actions_deferred"] == 1
+
+
+def test_real_interlock_reads_serving_gauge(monkeypatch):
+    """LoadInterlock against the real admission gauge: register a fake
+    server whose inflight crosses the fraction of the watermark."""
+    from seaweedfs_tpu.server.http_util import SERVING
+
+    class Busy:
+        def inflight_count(self):
+            return 600
+
+    busy = Busy()
+    SERVING.register_server(busy)
+    try:
+        monkeypatch.setenv("SWEED_MAX_INFLIGHT", "1000")
+        il = LoadInterlock(fraction=0.5)
+        allowed, reason = il.maintenance_allowed()
+        assert not allowed and "600" in reason
+        monkeypatch.setenv("SWEED_MAX_INFLIGHT", "10000")
+        allowed, _ = il.maintenance_allowed()
+        assert allowed
+    finally:
+        SERVING._servers.discard(busy)
+
+
+def test_pause_resume(mk):
+    world = World(make_vol(5, heat=WARM, garbage=0.9))
+    c = mk(world)
+    c.pause()
+    assert c.paused
+    s = c.tick()
+    assert s["deferred"] == "paused"
+    assert c.ops.executed == []
+    c.resume()
+    c.tick()
+    assert c.ops.executed == [("vacuum", 5)]
+
+
+def test_admin_lock_holder_skips_cycle(mk):
+    world = World(make_vol(5, heat=WARM, garbage=0.9))
+
+    def lease(_client):
+        raise RuntimeError("admin lock held by operator@shell")
+
+    c = mk(world, lease=lease)
+    s = c.tick()
+    assert c.ops.executed == []
+    assert "operator@shell" in s["locked"]
+    assert c.status()["counters"]["cycles_skipped_locked"] == 1
+
+
+def test_action_failure_does_not_kill_the_cycle(mk):
+    world = World(
+        make_vol(1, heat=WARM, garbage=0.9),
+        make_vol(2, heat=WARM, garbage=0.9),
+    )
+    ops = FakeOps(world, fail={"vacuum"})
+    c = mk(world, ops=ops)
+    s = c.tick()
+    assert [a["state"] for a in s["actions"]] == ["failed", "failed"]
+    assert c.status()["counters"]["actions_failed"] == 2
+
+
+# -- plan journal: crash recovery is idempotent -------------------------------
+
+def journal_doc(*actions, cycle=5, state="planned"):
+    base = {
+        "id": 1, "kind": "ec", "vid": 1, "collection": "",
+        "state": "running", "error": "", "detail": "",
+    }
+    acts = []
+    for i, a in enumerate(actions):
+        acts.append({**base, "id": i + 1, **a})
+    return {"cycle": cycle, "state": state, "started": 0.0, "actions": acts}
+
+
+def test_recover_resumes_running_and_abandons_planned(mk, tmp_path):
+    j = tmp_path / "lifecycle.json"
+    j.write_text(json.dumps(journal_doc(
+        {"kind": "ec", "vid": 1, "state": "running"},
+        {"kind": "vacuum", "vid": 2, "state": "planned"},
+    )))
+    world = World(make_vol(1, heat=COOL), make_vol(2, heat=WARM))
+    c = mk(world, LifecycleConfig(cold_streak=99), journal_path=str(j))
+    c._recover()
+    st = c.status()
+    assert st["counters"]["resumed"] == 1
+    assert st["counters"]["abandoned"] == 1
+    # the resumed EC still passes the present-state predicate → re-executed
+    # exactly once; the abandoned vacuum is NOT re-run (garbage is low, so
+    # the fresh plan doesn't re-derive it)
+    s = c.tick()
+    assert c.ops.executed == [("ec", 1)]
+    resumed = [a for a in s["actions"] if "[resumed]" in a["detail"]]
+    assert len(resumed) == 1 and resumed[0]["state"] == "done"
+
+
+def test_recover_completed_action_is_a_noop(mk, tmp_path):
+    """The crash landed AFTER the move finished but before the journal
+    marked it done: the volume is already EC, so replay must not re-EC."""
+    j = tmp_path / "lifecycle.json"
+    j.write_text(json.dumps(journal_doc(
+        {"kind": "ec", "vid": 1, "state": "running"},
+    )))
+    world = World(make_vol(1, heat=COOL, kind="ec", replicas=()))
+    c = mk(world, LifecycleConfig(cold_streak=99), journal_path=str(j))
+    c._recover()
+    c.tick()
+    assert c.ops.executed == []  # predicate failed: nothing double-scheduled
+
+
+def test_recover_marks_journal_done_so_replay_is_once(mk, tmp_path):
+    j = tmp_path / "lifecycle.json"
+    j.write_text(json.dumps(journal_doc(
+        {"kind": "ec", "vid": 1, "state": "running"},
+    )))
+    world = World(make_vol(1, heat=COOL))
+    c = mk(world, LifecycleConfig(cold_streak=99), journal_path=str(j))
+    c._recover()
+    # a second incarnation over the SAME journal finds it resolved
+    c2 = mk(world, LifecycleConfig(cold_streak=99), journal_path=str(j))
+    c2._recover()
+    assert c2.status()["counters"]["resumed"] == 0
+    assert c2.status()["counters"]["abandoned"] == 0
+
+
+def test_tick_journals_every_transition(mk, tmp_path):
+    j = tmp_path / "lifecycle.json"
+    world = World(make_vol(5, heat=WARM, garbage=0.9))
+    c = mk(world, journal_path=str(j))
+    c.tick()
+    doc = json.loads(j.read_text())
+    assert doc["state"] == "done"
+    assert [a["state"] for a in doc["actions"]] == ["done"]
+
+
+def test_corrupt_journal_is_tolerated(mk, tmp_path):
+    j = tmp_path / "lifecycle.json"
+    j.write_text("{torn")
+    world = World(make_vol(1, heat=WARM))
+    c = mk(world, journal_path=str(j))
+    c._recover()  # must not raise
+    assert c.status()["counters"]["resumed"] == 0
+
+
+# -- config + stats -----------------------------------------------------------
+
+def test_config_budget_env_override(monkeypatch):
+    monkeypatch.setenv("SWEED_LIFECYCLE_BUDGETS", "ec=7, vacuum=0, bogus=9")
+    cfg = LifecycleConfig.from_env()
+    assert cfg.budgets["ec"] == 7
+    assert cfg.budgets["vacuum"] == 0
+    assert "bogus" not in cfg.budgets
+
+
+def test_lifecycle_stats_aggregates(mk):
+    before = lifecycle_stats()
+    world = World(make_vol(5, heat=WARM, garbage=0.9))
+    c = mk(world)
+    c.tick()
+    after = lifecycle_stats()
+    assert after["controllers"] == before["controllers"] + 1
+    assert after["actions_done"] == before["actions_done"] + 1
+
+
+def test_status_shape(mk):
+    c = mk(World(make_vol(1)))
+    c.tick()
+    st = c.status()
+    assert {"paused", "cycle", "counters", "interlock", "tier",
+            "thresholds", "last_cycle"} <= set(st)
+    assert st["thresholds"]["heat_floor"] == pytest.approx(0.05)

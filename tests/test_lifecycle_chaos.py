@@ -1,0 +1,515 @@
+"""Kill-the-master-mid-cycle chaos matrix for the lifecycle autopilot.
+
+A child process runs one persistent cluster (master with meta_dir + two
+volume servers on disk-backed dirs) and drives ``lifecycle.tick()``
+manually over volumes whose write heat decays to the cool band within
+seconds (tiny SWEED_HEAT_HALFLIFE). A fault armed at one of the
+plan-journal faultpoints (``lifecycle.journal.planned`` / ``.running`` /
+``.done`` / ``.cycle`` / ``.recovered``) hard-kills the child
+(``os._exit(113)``) with exactly that journal state durable. The parent
+relaunches the child against the SAME state dirs; the restarted
+controller replays the journal and the child asserts the invariants the
+tentpole promises:
+
+* **no torn tier state** — after quiescing, no volume is registered both
+  plain and EC, and every seeded blob reads back byte-identical;
+* **no duplicated moves** — no (kind, vid) executes twice in the
+  recovery run, and a volume the crashed cycle already EC'd fails the
+  present-state predicate instead of being re-encoded;
+* **lifecycle.status reports the recovery** — resumed/abandoned counters
+  match the journal state the crash left behind.
+
+The fast subset (storm sanity + the two interesting crash windows) runs
+in tier-1; the full matrix plus the recovery-crash double-kill joins the
+soak (SWEED_SOAK=1). The scrub→repair end-to-end test at the bottom is
+in-process: corrupt a shard on disk, the SWEED_SCRUB thread flags it, the
+heartbeat carries it, the controller rebuilds it — no operator action.
+"""
+
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from seaweedfs_tpu.util import faultpoints
+
+pytestmark = pytest.mark.crash
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# The chaos child: one persistent cluster, manual lifecycle ticks. Ports,
+# volume dirs, master meta (election state + lifecycle journal), and the
+# expected-content manifest all live in the state dir so a relaunch
+# resumes the same cluster.
+CHILD = r"""
+import hashlib, json, os, sys, time
+
+statedir, op = sys.argv[1], sys.argv[2]
+faultspec = sys.argv[3] if len(sys.argv) > 3 else ""
+
+from seaweedfs_tpu.server.http_util import http_bytes, http_json
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.util import faultpoints
+
+ports_file = os.path.join(statedir, "ports.json")
+if os.path.exists(ports_file):
+    with open(ports_file) as f:
+        ports = json.load(f)
+else:
+    import socket
+    def free_port():
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]; s.close(); return p
+    ports = {k: free_port() for k in ("m", "v0", "v1")}
+    with open(ports_file, "w") as f:
+        json.dump(ports, f)
+
+master = MasterServer(
+    port=ports["m"], node_timeout=60,
+    meta_dir=os.path.join(statedir, "meta"),
+).start()
+vservers = []
+for k in ("v0", "v1"):
+    d = os.path.join(statedir, "vol_" + k)
+    os.makedirs(d, exist_ok=True)
+    vservers.append(VolumeServer(
+        [d], port=ports[k], master_url=master.url,
+        max_volume_count=20, pulse_seconds=0.3, ec_backend="numpy",
+    ).start())
+
+deadline = time.time() + 30
+while True:
+    try:
+        st = http_json("GET", "http://" + master.url + "/ec/fleet/status")
+        if len(st.get("members", {})) == 2:
+            break
+    except OSError:
+        pass
+    if time.time() > deadline:
+        raise SystemExit("fleet members never registered")
+    time.sleep(0.2)
+
+vurls = [v.store.public_url for v in vservers]
+lc = master.lifecycle
+expected_file = os.path.join(statedir, "expected.json")
+
+
+def read_fid(fid):
+    for u in vurls:
+        try:
+            s, data = http_bytes("GET", "http://%s/%s" % (u, fid))
+            if s == 200:
+                return data
+        except OSError:
+            pass
+    return None
+
+
+def run_ticks(max_ticks=30):
+    # drive cycles until two consecutive quiet ones; an armed journal
+    # fault hard-kills us somewhere inside a tick
+    executed, quiet = [], 0
+    for _ in range(max_ticks):
+        s = lc.tick()
+        executed += [
+            (a["kind"], a["vid"], a["state"])
+            for a in s["actions"]
+            if a["state"] in ("done", "failed")
+        ]
+        quiet = quiet + 1 if not s["actions"] else 0
+        if quiet >= 2:
+            return executed
+        time.sleep(0.5)
+    raise SystemExit("lifecycle never quiesced: " + repr(executed))
+
+
+def check_converged():
+    # fresh delta heartbeats after the last move land within ~2 pulses
+    time.sleep(1.0)
+    from seaweedfs_tpu.cluster.lifecycle import observe_topology
+
+    obs = observe_topology(master)
+    torn = {
+        v: (ob["replicas"], sorted(ob["ec_shards"]))
+        for v, ob in obs.items()
+        if ob["replicas"] and ob["ec_shards"]
+    }
+    assert not torn, "torn plain+EC state: %r" % (torn,)
+    with open(expected_file) as f:
+        expected = json.load(f)
+    seeded_vids = {int(fid.split(",")[0]) for fid in expected}
+    ec_vids = {v for v, ob in obs.items() if ob["kind"] == "ec"}
+    assert seeded_vids <= ec_vids, (
+        "seeded volumes not all EC after quiesce: %r vs %r"
+        % (sorted(seeded_vids), sorted(ec_vids))
+    )
+    bad = [
+        fid
+        for fid, want in expected.items()
+        if (lambda d: d is None or hashlib.sha1(d).hexdigest() != want)(
+            read_fid(fid)
+        )
+    ]
+    assert not bad, "wrong bytes after recovery: %r" % (bad,)
+    return sorted(seeded_vids)
+
+
+if op == "storm":
+    expected = {}
+    for i, coll in enumerate(["", "c1", "c2"]):
+        a = http_json(
+            "GET",
+            "http://%s/dir/assign?collection=%s" % (master.url, coll),
+        )
+        body = ("%s:%d|" % (coll or "default", i)).encode() * 4096
+        s, _ = http_bytes("POST", "http://%s/%s" % (a["url"], a["fid"]), body)
+        assert s == 201, (s, a)
+        expected[a["fid"]] = hashlib.sha1(body).hexdigest()
+    with open(expected_file, "w") as f:
+        json.dump(expected, f)
+    # tiny SWEED_HEAT_HALFLIFE: the write heat decays into the cool band
+    time.sleep(1.5)
+    if faultspec:
+        faultpoints._parse_env(faultspec)
+    executed = run_ticks()
+    # unfaulted sanity leg: each seeded volume EC'd exactly once, bytes
+    # intact — so a matrix pass means the faults fired, not that the
+    # autopilot never acted
+    done = [(k, v) for k, v, st in executed if st == "done"]
+    assert len(done) == len(set(done)), "duplicate moves: %r" % (executed,)
+    vids = check_converged()
+    # every seeded volume was EC'd by the autopilot, exactly once (the
+    # assign path auto-grows empty spares; those cool and EC too)
+    ec_vids = sorted(v for k, v in done if k == "ec")
+    assert set(vids) <= set(ec_vids), (executed, vids)
+    print("STORM " + json.dumps(executed))
+elif op == "verify":
+    if faultspec:
+        # the .recovered window: the crash fires inside _recover below
+        faultpoints._parse_env(faultspec)
+    time.sleep(1.5)  # both servers heartbeat their volume/shard maps in
+    lc._recover()
+    st = lc.status()
+    print("RECOVERY " + json.dumps(st["recovery"]))
+    print("COUNTERS " + json.dumps(st["counters"]))
+    executed = run_ticks()
+    done = [(k, v) for k, v, state in executed if state == "done"]
+    assert len(done) == len(set(done)), "duplicate moves: %r" % (executed,)
+    check_converged()
+    print("VERIFY " + json.dumps(executed))
+else:
+    raise SystemExit("unknown op " + op)
+
+for v in vservers:
+    v.stop()
+master.stop()
+print("CHILD-COMPLETED")
+"""
+
+
+def run_child(args, faultspec=None, expect_crash=False, timeout=240):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # cooling observable in seconds; one cold beat is enough
+        SWEED_HEAT_HALFLIFE="0.25",
+        SWEED_LIFECYCLE_COLD_STREAK="1",
+        SWEED_LIFECYCLE_MAX_ACTIONS="6",
+        SWEED_LIFECYCLE_BUDGETS="ec=6",  # drain the auto-grown spares fast
+        SWEED_MESH="1",  # single-process mesh per server → fleet members
+    )
+    for var in ("SWEED_FAULTPOINTS", "SWEED_LIFECYCLE", "SWEED_TIER_ENDPOINT",
+                "SWEED_SCRUB", "SWEED_TURBO", "SWEED_MESH_COORDINATOR",
+                "SWEED_MESH_NUM_PROCESSES", "SWEED_MESH_PROCESS_ID"):
+        env.pop(var, None)
+    argv = [sys.executable, "-c", CHILD] + [str(a) for a in args]
+    if faultspec:
+        argv.append(faultspec)
+    proc = subprocess.run(
+        argv, cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    if expect_crash:
+        assert proc.returncode == faultpoints.CRASH_EXIT_CODE, (
+            f"child exited {proc.returncode}, wanted injected-crash "
+            f"{faultpoints.CRASH_EXIT_CODE}\nstdout: {proc.stdout[-800:]}"
+            f"\nstderr: {proc.stderr[-2000:]}"
+        )
+        assert "CHILD-COMPLETED" not in proc.stdout
+    else:
+        assert proc.returncode == 0, (
+            f"child exited {proc.returncode}\nstdout: {proc.stdout[-1000:]}"
+            f"\nstderr: {proc.stderr[-2000:]}"
+        )
+        assert "CHILD-COMPLETED" in proc.stdout
+    return proc
+
+
+def child_json(proc, tag):
+    for ln in proc.stdout.splitlines():
+        if ln.startswith(tag + " "):
+            return json.loads(ln[len(tag) + 1:])
+    raise AssertionError(f"no {tag} line in child stdout: {proc.stdout[-500:]}")
+
+
+# (faultspec for the storm run, min resumed, exact-or-None abandoned
+# floor): each plan-journal crash window leaves a distinct durable state
+# the recovery must classify correctly.
+FULL_MATRIX = [
+    # plan durable, nothing started: every action abandoned, none resumed
+    ("lifecycle.journal.planned=crash", 0, 1),
+    # first action marked running but never executed: it must resume
+    ("lifecycle.journal.running=crash", 1, None),
+    # first action executed AND journaled done: nothing resumes (the
+    # predicate re-derives the rest from fresh observation)
+    ("lifecycle.journal.done=crash", 0, None),
+    # cycle closed: the journal is resolved, recovery is a no-op
+    ("lifecycle.journal.cycle=crash", 0, 0),
+]
+
+FAST_MATRIX = [FULL_MATRIX[0], FULL_MATRIX[1]]
+
+
+def assert_recovery(proc, min_resumed, abandoned):
+    counters = child_json(proc, "COUNTERS")
+    assert counters["resumed"] >= min_resumed, counters
+    if abandoned is not None:
+        if abandoned == 0:
+            assert counters["abandoned"] == 0, counters
+        else:
+            assert counters["abandoned"] >= abandoned, counters
+    if min_resumed == 0 and abandoned == 0:
+        # .cycle: the crashed cycle completed; recovery reports nothing
+        assert child_json(proc, "RECOVERY") == {}, proc.stdout[-500:]
+    else:
+        assert child_json(proc, "RECOVERY"), proc.stdout[-500:]
+
+
+def test_autopilot_converges_without_faults(tmp_path):
+    """Harness sanity + the autopilot's live e2e: cooling volumes get
+    fleet-EC'd exactly once, unprompted, and every blob survives."""
+    proc = run_child([tmp_path, "storm"])
+    done = child_json(proc, "STORM")
+    assert any(k == "ec" for k, v, st in done), done
+
+
+@pytest.mark.parametrize(
+    "faultspec,min_resumed,abandoned", FAST_MATRIX,
+    ids=[m[0].split("=")[0] for m in FAST_MATRIX],
+)
+def test_kill_master_matrix_fast(tmp_path, faultspec, min_resumed, abandoned):
+    run_child([tmp_path, "storm"], faultspec, expect_crash=True)
+    proc = run_child([tmp_path, "verify"])
+    assert_recovery(proc, min_resumed, abandoned)
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.skipif(
+    os.environ.get("SWEED_SOAK") != "1",
+    reason="full lifecycle crash matrix is soak-gated; fast subset covers "
+           "tier-1",
+)
+@pytest.mark.parametrize(
+    "faultspec,min_resumed,abandoned", FULL_MATRIX,
+    ids=[m[0].split("=")[0] for m in FULL_MATRIX],
+)
+def test_kill_master_matrix_full(tmp_path, faultspec, min_resumed, abandoned):
+    run_child([tmp_path, "storm"], faultspec, expect_crash=True)
+    proc = run_child([tmp_path, "verify"])
+    assert_recovery(proc, min_resumed, abandoned)
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+@pytest.mark.skipif(
+    os.environ.get("SWEED_SOAK") != "1",
+    reason="double-kill (crash during recovery) is soak-gated",
+)
+def test_kill_master_again_during_recovery(tmp_path):
+    """The .recovered window: die mid-cycle, then die AGAIN right after
+    the replacement master journals its recovery. The third incarnation
+    must find a resolved journal (no double resume) and still converge."""
+    run_child(
+        [tmp_path, "storm"], "lifecycle.journal.running=crash",
+        expect_crash=True,
+    )
+    run_child(
+        [tmp_path, "verify"], "lifecycle.journal.recovered=crash",
+        expect_crash=True,
+    )
+    proc = run_child([tmp_path, "verify"])
+    counters = child_json(proc, "COUNTERS")
+    # incarnation 2 journaled the recovery before dying, so incarnation 3
+    # sees a resolved journal: nothing resumed twice
+    assert counters["resumed"] == 0 and counters["abandoned"] == 0, counters
+
+
+# -- scrub → repair end to end (in-process) -----------------------------------
+
+def wait_until(pred, timeout=30.0, interval=0.2, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_scrub_detected_shard_corruption_repaired_end_to_end(
+    tmp_path, monkeypatch
+):
+    """Corrupt a shard on disk → the SWEED_SCRUB thread hash-flags it →
+    the heartbeat carries it to the master → the lifecycle controller
+    schedules the rebuild → reads serve correct bytes. Zero operator
+    actions between the corruption and the repair."""
+    monkeypatch.setenv("SWEED_SCRUB", "1")
+    monkeypatch.setenv("SWEED_MESH", "1")
+    for var in ("SWEED_FAULTPOINTS", "SWEED_TIER_ENDPOINT",
+                "SWEED_MESH_COORDINATOR", "SWEED_MESH_NUM_PROCESSES",
+                "SWEED_MESH_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    from seaweedfs_tpu.server.http_util import http_bytes, http_json
+    from seaweedfs_tpu.server.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.shell import commands as C
+
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    vservers = [
+        VolumeServer(
+            [str(tmp_path / f"v{i}")], port=free_port(),
+            master_url=master.url, max_volume_count=20,
+            pulse_seconds=0.3, ec_backend="numpy",
+        ).start()
+        for i in range(2)
+    ]
+    try:
+        wait_until(
+            lambda: len(
+                http_json("GET", f"http://{master.url}/ec/fleet/status")
+                .get("members", {})
+            ) == 2,
+            what="fleet members",
+        )
+        a = http_json("GET", f"http://{master.url}/dir/assign")
+        body = bytes(range(256)) * 300
+        st, _ = http_bytes("POST", f"http://{a['url']}/{a['fid']}", body)
+        assert st == 201
+        vid = int(a["fid"].split(",")[0])
+        C.ec_encode_fleet(C.CommandEnv(master.url), [vid])
+
+        # corrupt the LOWEST local shard slot somewhere: the scrub cursor
+        # starts at slot 0, so detection lands within ~2 scrub rounds
+        shard_path = wait_until(
+            lambda: next(
+                (
+                    os.path.join(str(tmp_path / f"v{i}"), fn)
+                    for i in range(2)
+                    for fn in sorted(os.listdir(str(tmp_path / f"v{i}")))
+                    if ".ec0" in fn
+                ),
+                None,
+            ),
+            what="a committed shard file",
+        )
+        with open(shard_path, "r+b") as f:
+            f.seek(128)
+            f.write(b"\xff" * 64)  # same size, wrong bytes
+
+        def corrupt_seen():
+            for dn in master.master.topo.data_nodes():
+                if dn.ec_corrupt.get(vid):
+                    return dict(dn.ec_corrupt)
+            return None
+
+        flagged = wait_until(corrupt_seen, what="scrub finding in topology")
+        assert vid in flagged
+
+        # the autopilot repairs it: repair actions need no cold streak
+        summary = wait_until(
+            lambda: (
+                lambda s: s
+                if any(
+                    a["kind"] == "repair_shard" and a["state"] == "done"
+                    for a in s["actions"]
+                )
+                else None
+            )(master.lifecycle.tick()),
+            timeout=60,
+            interval=0.5,
+            what="repair_shard action",
+        )
+        assert summary["actions"], summary
+
+        # the finding clears from the topology and reads are correct
+        wait_until(lambda: not corrupt_seen(), what="finding cleared")
+        got = None
+        for v in vservers:
+            s, data = http_bytes(
+                "GET", f"http://{v.store.public_url}/{a['fid']}"
+            )
+            if s == 200:
+                got = data
+                break
+        assert got == body, "read after repair returned wrong bytes"
+        # and the repair landed in the counters the gauges export
+        assert (
+            master.lifecycle.status()["counters"]["actions_done"] >= 1
+        )
+    finally:
+        for v in vservers:
+            v.stop()
+        master.stop()
+
+
+# ------------------------------------------------------ probe smoke test
+def test_bench_probe_lifecycle_smoke():
+    """Fast end-to-end run of bench.py --probe-lifecycle: small corpus,
+    real cluster, fake-S3 tier.  Guards the plumbing plus the probe's two
+    hard contracts — every GET byte-verified through every tier
+    transition, and the end state tracking the drifted heat (cold volumes
+    moved off the hot path, live-hot volumes still plain+local).  The
+    p99-ratio bound is generous here: the tight acceptance bar belongs to
+    the full-size probe on quiet hardware, not a loaded CI worker."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("SWEED_FAULTPOINTS", "SWEED_LIFECYCLE", "SWEED_SCRUB",
+              "SWEED_TIER_ENDPOINT", "SWEED_HEAT_HALFLIFE"):
+        env.pop(k, None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+         "--probe-lifecycle", "28", "800"],
+        capture_output=True, text=True, timeout=240, cwd=REPO_ROOT, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    # byte-verified reads through EC encodes and S3 uploads: zero tolerance
+    assert out["mismatched"] == 0, out
+    assert out["failed"] == 0, out
+    for phase in ("quiesced", "live"):
+        assert out[phase]["n"] == 400, out[phase]
+    # the autopilot moved the cooled volumes and spared the live-hot ones
+    tr = out["tracking"]
+    assert tr["cold_moved"] >= 1, tr
+    assert tr["hot_still_local"] == tr["hot_total"], (tr, out["end_state"])
+    assert tr["fraction"] >= 0.7, tr
+    # cold bytes actually landed on the S3 tier
+    assert out["tier"]["s3_bytes"] > 0, out["tier"]
+    assert out["actions"]["actions_done"] >= 1, out["actions"]
+    # maintenance tax on tail latency is bounded even on a loaded worker
+    assert out["p99_ratio"] is not None and out["p99_ratio"] < 25, out

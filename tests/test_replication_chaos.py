@@ -428,12 +428,29 @@ def test_survivor_serves_reads_and_failover(tmp_path):
             SURVIVOR_CHILD, [tmp_path, "storm", fb.url],
             "repl.sink.write=crash::10", expect_crash=True,
         )
-        # the survivor serves reads of what made it across
+        # --- degraded-read leg: prove A is DOWN *right now*, then serve
+        # every replicated file from the survivor while it stays down.
+        # Without the refused-connection check a slow child teardown could
+        # leave A half-alive and the "degraded" reads would prove nothing.
+        with open(tmp_path / "ports.json") as f:
+            a_ports = json.load(f)
+        for name, port in sorted(a_ports.items()):
+            with pytest.raises(OSError):
+                socket.create_connection(
+                    ("127.0.0.1", port), timeout=2
+                ).close()
+        # every file that crossed before the kill reads back byte-correct
+        # from the survivor — hashes checked against the storm generator,
+        # not just a 200 (tree_hash already asserts per-file status)
         replicated = tree_hash(fb.url, "/sync")
         assert len(replicated) >= 5, sorted(replicated)
-        for p in list(replicated)[:3]:
-            status, data, _ = FilerClient(fb.url).get_object(p)
-            assert status == 200 and data
+
+        def storm_blob(i):
+            return (f"storm:{i}|").encode() * (37 + i * 13)
+
+        for p, digest in sorted(replicated.items()):
+            i = int(p.rsplit("_", 1)[1].split(".")[0])
+            assert digest == hashlib.sha1(storm_blob(i)).hexdigest(), p
         # traffic fails over: clients write to the survivor
         for i in range(5):
             s, _ = http_bytes(

@@ -104,6 +104,47 @@ def test_volume_balance_evens_spread(trio):
     assert res2["plan"] == []
 
 
+def test_volume_balance_heat_revalidates_at_execution(monkeypatch):
+    """The -heat plan is computed over a heartbeat snapshot; by the time a
+    move executes, its source may have died, stopped holding the volume, or
+    the target may already hold a replica (an earlier move in the same loop
+    can do all three).  Every entry must re-check FRESH state and skip with
+    a reason instead of exploding or duplicating a replica."""
+    plan = [
+        {"vid": 1, "from": "n1:8080", "to": "n2:8080"},  # target died
+        {"vid": 2, "from": "n1:8080", "to": "n3:8080"},  # source lost it
+        {"vid": 3, "from": "n1:8080", "to": "n3:8080"},  # target holds it
+        {"vid": 4, "from": "n1:8080", "to": "n3:8080"},  # still valid
+    ]
+    monkeypatch.setattr(
+        C, "_heat_balance_plan", lambda vols, nodes: [dict(m) for m in plan]
+    )
+    monkeypatch.setattr(C, "volume_list", lambda env: [])
+    moved = []
+    monkeypatch.setattr(
+        C, "volume_move",
+        lambda env, vid, to, src: moved.append((vid, src, to)),
+    )
+
+    class FreshEnv:
+        def data_nodes(self):
+            return [{"url": "n1:8080"}, {"url": "n3:8080"}]  # n2 is gone
+
+        def volume_locations(self, vid):
+            return {
+                2: ["n9:8080"],             # source no longer holds vol 2
+                3: ["n1:8080", "n3:8080"],  # target already holds vol 3
+            }.get(vid, ["n1:8080"])
+
+    res = C.volume_balance(FreshEnv(), heat=True)
+    assert moved == [(4, "n1:8080", "n3:8080")]
+    assert [m["vid"] for m in res["moved"]] == [4]
+    reasons = {m["vid"]: m["reason"] for m in res["skipped"]}
+    assert "died" in reasons[1], reasons
+    assert "no longer holds" in reasons[2], reasons
+    assert "already holds" in reasons[3], reasons
+
+
 def test_evacuate_drains_server(trio):
     master, servers, env = trio
     a = operation.assign(master.url)

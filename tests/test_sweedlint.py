@@ -46,6 +46,9 @@ CASES = [
     # PR 12 observability: per-request identifiers must stay out of
     # metric label sets (they belong in span tags)
     ("metric-cardinality", "metric_cardinality", "server/fixture.py"),
+    # PR 14 lifecycle autopilot: maintenance loops must yield to traffic
+    ("maintenance-without-interlock", "maintenance_without_interlock",
+     "cluster/fixture.py"),
 ]
 
 
