@@ -552,10 +552,27 @@ def probe_serving(mode: str, conns_csv: str, total: int) -> None:
       saturation queueing. This is the p99-bounded-vs-64-conns verdict.
 
     Prints one JSON line:
-    {"mode", "sweep": [{conns, sat: {...}, paced: {...}}]}."""
+    {"mode", "sweep": [{conns, sat: {...}, paced: {...}}],
+     "serving_state": {native_hits, native_fallbacks, ...},
+     "qos": {solo: {...}, contended: {...}, isolation_ok}}.
+
+    ``serving_state`` is the served filer's /_status serving snapshot —
+    in aio mode the native_hits counter is the evidence that the sweep
+    actually exercised the native loop path, not the bridge.
+
+    The ``qos`` phase runs against a SECOND filer started with a tenant
+    governor budget (SWEED_QOS_RPS): a compliant tenant is paced solo,
+    then again while a misbehaving tenant offers 10× its rate. Both
+    per-tenant p99s come from the server's /metrics histogram quantiles
+    (sweed_qos_request_seconds), shed counts from
+    sweed_qos_decisions_total — the isolation verdict is assertable
+    without log-greps."""
     import asyncio
+    import math
+    import re
     import socket
     import tempfile
+    import urllib.request
 
     from seaweedfs_tpu.filer.client import FilerClient
 
@@ -762,6 +779,226 @@ def probe_serving(mode: str, conns_csv: str, total: int) -> None:
                     c, min(total, 6000), out["paced_target_rps"]
                 ))
                 out["sweep"].append(row)
+            try:
+                st = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{fp}/_status", timeout=10
+                ).read())
+                out["serving_state"] = st.get("serving", {})
+            except Exception as e:  # noqa: BLE001 — evidence, not verdict
+                out["serving_state"] = {"error": str(e)[:120]}
+
+            # ---- per-tenant QoS isolation phase (second filer, governed)
+            # budget well under the box's capacity knee (sat phase shows
+            # ~2000 rps here): admission control pins the compliant
+            # tenant's p99 only when the TOTAL admitted load leaves
+            # headroom — a budget at the knee trades shed for queueing
+            qp = free_port()
+            qos_rps = 400
+            procs.append(spawn(
+                "import time\n"
+                "from seaweedfs_tpu.server.filer_server import FilerServer\n"
+                f"FilerServer(host='127.0.0.1', port={qp}, "
+                f"master_url='127.0.0.1:{mp}').start()\n"
+                "time.sleep(3600)\n",
+                extra_env=dict(
+                    serve_env,
+                    SWEED_QOS_RPS=str(qos_rps),
+                    SWEED_QOS_MAX_DELAY_MS="250",
+                ),
+            ))
+            wait_port(qp)
+            # the governed filer has its own (in-memory) metadata store:
+            # re-publish the corpus there, then warm its chunk cache
+            qclient = FilerClient(f"127.0.0.1:{qp}")
+            for p in paths:
+                qclient.put_object(p, bodies[p])
+            for p in paths:
+                st, got, _ = qclient.get_object(p)
+                if st != 200 or got != bodies[p]:
+                    raise RuntimeError(f"governed filer corpus bad: {p}")
+
+            async def qos_worker(tenant, wid, interval, t_end, counters,
+                                 lat):
+                # shed replies close the connection (backpressure reaches
+                # the abuser's socket), so the worker reconnects instead
+                # of dying — the pacing schedule stays absolute
+                reader = writer = None
+                k = 0
+                t_start = time.perf_counter() + (wid % 53) / 53.0 * interval
+                while True:
+                    due = t_start + k * interval
+                    if due >= t_end:
+                        break
+                    delay = due - time.perf_counter()
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                    k += 1
+                    if writer is None:
+                        try:
+                            reader, writer = await asyncio.wait_for(
+                                asyncio.open_connection("127.0.0.1", qp),
+                                timeout=10,
+                            )
+                        except (OSError, asyncio.TimeoutError):
+                            counters["failed"] += 1
+                            continue
+                    p = paths[(wid + k) % len(paths)]
+                    req = (
+                        f"GET {p} HTTP/1.1\r\nHost: b\r\n"
+                        f"X-Sweed-Tenant: {tenant}\r\n"
+                        f"Content-Length: 0\r\n\r\n"
+                    ).encode()
+                    t0 = time.perf_counter()
+                    try:
+                        writer.write(req)
+                        await writer.drain()
+                        head = await asyncio.wait_for(
+                            reader.readuntil(b"\r\n\r\n"), 30
+                        )
+                        status = int(head.split(b" ", 2)[1])
+                        clen, will_close = 0, False
+                        for ln in head.split(b"\r\n"):
+                            low = ln.lower()
+                            if low.startswith(b"content-length:"):
+                                clen = int(ln.split(b":")[1])
+                            elif low.startswith(b"connection:") and (
+                                b"close" in low
+                            ):
+                                will_close = True
+                        body = await asyncio.wait_for(
+                            reader.readexactly(clen), 30
+                        )
+                    except (OSError, asyncio.TimeoutError,
+                            asyncio.IncompleteReadError,
+                            asyncio.LimitOverrunError):
+                        counters["failed"] += 1
+                        writer.close()
+                        reader = writer = None
+                        continue
+                    if status == 503:
+                        counters["shed"] += 1
+                    elif status == 200 and body == bodies[p]:
+                        counters["ok"] += 1
+                        lat.append(time.perf_counter() - t0)
+                    else:
+                        counters["mismatched"] += 1
+                    if will_close:
+                        writer.close()
+                        reader = writer = None
+                if writer is not None:
+                    writer.close()
+
+            async def qos_phase(tenants, secs):
+                # tenants: (name, offered_rps, conns)
+                res = {}
+                tasks = []
+                t_end = time.perf_counter() + secs
+                for name, rps, nconn in tenants:
+                    counters = {"ok": 0, "shed": 0, "failed": 0,
+                                "mismatched": 0}
+                    lat = []
+                    res[name] = (counters, lat)
+                    interval = nconn / rps
+                    tasks.extend(
+                        qos_worker(name, i, interval, t_end, counters, lat)
+                        for i in range(nconn)
+                    )
+                await asyncio.gather(*tasks)
+                out = {}
+                for name, (counters, lat) in res.items():
+                    lat.sort()
+                    n = len(lat)
+                    out[name] = dict(
+                        counters,
+                        client_p99_ms=round(
+                            lat[max(0, int(n * 0.99) - 1)] * 1e3, 2
+                        ) if n else None,
+                    )
+                return out
+
+            def scrape_qos():
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{qp}/metrics", timeout=10
+                ).read().decode()
+                buckets: dict = {}
+                for m in re.finditer(
+                    r'sweed_qos_request_seconds_bucket\{([^}]*)\}\s+(\d+)',
+                    text,
+                ):
+                    lab = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
+                    le = lab.get("le", "")
+                    edge = math.inf if le == "+Inf" else float(le)
+                    buckets.setdefault(lab.get("tenant", ""), []).append(
+                        (edge, int(m.group(2)))
+                    )
+                sheds: dict = {}
+                delays: dict = {}
+                for m in re.finditer(
+                    r'sweed_qos_decisions_total\{([^}]*)\}\s+(\d+)', text
+                ):
+                    lab = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
+                    if lab.get("outcome") == "shed":
+                        sheds[lab.get("tenant", "")] = int(m.group(2))
+                    elif lab.get("outcome") == "delay":
+                        delays[lab.get("tenant", "")] = int(m.group(2))
+                qt = {}
+                for tenant, bs in buckets.items():
+                    bs.sort()
+                    total_n = bs[-1][1]
+                    p99 = None
+                    if total_n:
+                        rank = 0.99 * total_n
+                        prev_c, prev_e = 0, 0.0
+                        for edge, cum in bs:
+                            if cum >= rank:
+                                span = cum - prev_c
+                                e = edge if math.isfinite(edge) else prev_e
+                                p99 = prev_e + (
+                                    (e - prev_e) * (rank - prev_c) / span
+                                    if span else 0.0
+                                )
+                                break
+                            prev_c, prev_e = cum, (
+                                edge if math.isfinite(edge) else prev_e
+                            )
+                    qt[tenant] = {
+                        "count": total_n,
+                        "p99_ms": round(p99 * 1e3, 2) if p99 is not None
+                        else None,
+                        "shed": sheds.get(tenant, 0),
+                        "delayed": delays.get(tenant, 0),
+                    }
+                return qt
+
+            # the compliant tenant stays strictly under its fair share
+            # (150 < 400/2) so it never owes pacing delay; greedy needs
+            # open-loop concurrency past max_delay × its share
+            # (0.25s × 200rps = 50 in-flight) or pacing absorbs the whole
+            # overage and shed never triggers
+            solo = asyncio.run(qos_phase([("c-solo", 150, 8)], 6.0))
+            contended = asyncio.run(qos_phase(
+                [("c-load", 150, 8), ("greedy", 2000, 128)], 8.0
+            ))
+            server_view = scrape_qos()
+            solo_p99 = server_view.get("hdr:c-solo", {}).get("p99_ms")
+            cont_p99 = server_view.get("hdr:c-load", {}).get("p99_ms")
+            out["qos"] = {
+                "total_rps_budget": qos_rps,
+                "solo": solo,
+                "contended": contended,
+                "server_metrics": server_view,
+                "compliant_solo_p99_ms": solo_p99,
+                "compliant_contended_p99_ms": cont_p99,
+                "isolation_ok": bool(
+                    solo_p99 and cont_p99 and cont_p99 <= 2.0 * solo_p99
+                ),
+                "greedy_shed": server_view.get("hdr:greedy", {}).get(
+                    "shed", 0
+                ),
+                "greedy_delayed": server_view.get("hdr:greedy", {}).get(
+                    "delayed", 0
+                ),
+            }
         finally:
             for p in procs:
                 p.terminate()
@@ -2377,8 +2614,10 @@ def main() -> None:
     serving = {}
     for mode in ("threads", "aio"):
         try:
+            # the qos isolation phase adds ~20s of fixed-duration paced
+            # traffic on top of the connection sweep
             r = _run_probe(["--probe-serving", mode, "64,1024", "20000"],
-                           timeout=420)
+                           timeout=540)
             if r.returncode == 0 and r.stdout.strip():
                 serving[mode] = json.loads(r.stdout.strip().splitlines()[-1])
                 for row in serving[mode]["sweep"]:
@@ -2390,6 +2629,17 @@ def main() -> None:
                         f"p50={p['p50_ms']}ms p99={p['p99_ms']}ms "
                         f"failed={p['failed']} mismatched={p['mismatched']}"
                     )
+                ss = serving[mode].get("serving_state", {})
+                qos = serving[mode].get("qos", {})
+                log(
+                    f"serving[{mode}] native_hits="
+                    f"{ss.get('native_hits')} fallbacks="
+                    f"{ss.get('native_fallbacks')}; qos compliant p99 "
+                    f"solo={qos.get('compliant_solo_p99_ms')}ms vs "
+                    f"contended={qos.get('compliant_contended_p99_ms')}ms "
+                    f"(greedy shed={qos.get('greedy_shed')}) "
+                    f"isolation_ok={qos.get('isolation_ok')}"
+                )
             else:
                 tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
                 log(f"serving probe [{mode}] failed: {tail[0][:140]}")

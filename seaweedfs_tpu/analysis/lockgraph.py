@@ -71,6 +71,15 @@ _TRANSPORT_FUNCS = frozenset(
     }
 )
 
+#: thread-bridge constructs a NATIVE-async handler (``async def
+#: *_native``) must never touch: native routes exist to skip the
+#: worker-thread hop, so re-introducing the flume/executor bridge inside
+#: one silently pays the hop the route was split to remove. Flagged even
+#: when awaited — awaiting a thread hop still schedules the thread.
+_NATIVE_BRIDGE = frozenset(
+    {"ThreadFlume", "run_in_executor", "_run_request"}
+)
+
 #: jax.lax cross-device collectives: dispatching one is a synchronization
 #: point for EVERY process in the mesh, so doing it while holding a product
 #: lock convoys the whole fleet behind one node's lock (and deadlocks
@@ -678,6 +687,43 @@ class LockGraphBuilder:
                 continue
             env = self.cg.local_types(fi)
             self._loop_walk(fi, fi.node, env, seen)
+            if fi.name.endswith("_native"):
+                self._native_bridge_walk(fi, seen)
+
+    def _native_bridge_walk(self, fi: FuncInfo, seen: set) -> None:
+        """Native-async handlers (``async def *_native``) must stay on
+        the loop end to end: ThreadFlume construction, executor
+        dispatch, or the bridged ``_run_request`` inside one re-adds the
+        worker-thread hop the native route exists to remove. Unlike the
+        base walk, awaited calls are NOT exempt here — awaiting a
+        thread hop still schedules the thread."""
+        for child in ast.walk(fi.node):
+            if not isinstance(child, ast.Call):
+                continue
+            f = child.func
+            name = (
+                f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute)
+                else None
+            )
+            if name not in _NATIVE_BRIDGE:
+                continue
+            key = (fi.relpath, child.lineno, f"native-bridge {name}")
+            if key in seen:
+                continue
+            seen.add(key)
+            self._loop_v.append(
+                Violation(
+                    "blocking-on-loop",
+                    fi.relpath,
+                    child.lineno,
+                    f"thread-bridge {name} inside native-async handler "
+                    f"{fi.name}: native routes exist to skip the "
+                    "worker-thread hop — stay on the loop or return "
+                    "NATIVE_FALLBACK so the bridged route serves it "
+                    "(docs/ANALYSIS.md)",
+                )
+            )
 
     def _loop_walk(
         self, fi: FuncInfo, node: ast.AST, env: dict, seen: set

@@ -14,6 +14,7 @@ SigV2) and authorized per identity action grants (`auth_credentials.go:124`).
 from __future__ import annotations
 
 import hashlib
+import json
 import threading
 import time
 import urllib.parse
@@ -448,6 +449,112 @@ class S3ApiServer:
         # file-like body: the handler streams it through in pieces
         resp_headers["Content-Length-Override"] = clen
         return status, data, resp_headers
+
+    async def _get_object_native(self, h, path, query):
+        """Native-async GetObject: SigV4/SigV2 verification is pure HMAC
+        (runs on the loop), bucket policy comes from the cache ONLY, and
+        both the entry lookup and the body ride the asyncio pooled
+        transport to the filer. Every edge falls back to the bridged
+        handler for canonical error/XML bytes: sub-resources and
+        presigned URLs (query params), auth failures, anonymous access,
+        uncached policies, missing keys, directories."""
+        from ..server.aio_transport import AStreamBody
+        from ..server.aio_transport import request as arequest
+        from ..server.aio_transport import stream as astream
+        from ..server.http_util import NATIVE_FALLBACK, AsyncStreamBody
+
+        if query:
+            return NATIVE_FALLBACK  # ?subresource / presigned stay bridged
+        headers = {k.title(): v for k, v in h.headers.items()}
+        try:
+            identity, err = self.iam.authenticate(
+                "GET", path, query, headers, b""
+            )
+        except Exception:  # noqa: BLE001 — bridge renders the auth error
+            return NATIVE_FALLBACK
+        if err:
+            return NATIVE_FALLBACK  # incl. anonymous: policy Allow is rare
+        upath = urllib.parse.unquote(path)
+        parts = upath.lstrip("/").split("/", 1)
+        bucket = parts[0] if parts[0] else ""
+        key = parts[1] if len(parts) > 1 else ""
+        if not bucket or not key or bucket.startswith("."):
+            return NATIVE_FALLBACK  # service/bucket ops, internal dirs
+        with self._policy_lock:
+            cached = self._policy_cache.get(bucket)
+        if cached is None:
+            return NATIVE_FALLBACK  # bridge fetches + caches the policy
+        pol = cached[0]
+        verdict = None
+        if pol is not None:
+            verdict = pe.evaluate(
+                pol,
+                identity.access_key if identity else "",
+                pe.ACTION_NAMES.get(s3auth.ACTION_READ, "s3:*"),
+                pe.arn(bucket, key),
+            )
+        if verdict is None:
+            verdict = identity is None or identity.can_do(
+                s3auth.ACTION_READ, bucket
+            )
+        if not verdict:
+            return NATIVE_FALLBACK  # canonical AccessDenied stays bridged
+        t0 = time.monotonic()
+        opath = self._object_path(bucket, key)
+        try:
+            status, body, _ = await arequest(
+                "GET", self.client._u(opath, meta="true")
+            )
+        except Exception:  # noqa: BLE001 — bridged client owns retries
+            return NATIVE_FALLBACK
+        if status != 200:
+            return NATIVE_FALLBACK  # canonical NoSuchKey stays bridged
+        entry = json.loads(body)
+        if entry.get("is_directory"):
+            return NATIVE_FALLBACK
+        resp_headers = {
+            "Content-Type": entry.get("mime") or "application/octet-stream",
+            "ETag": f'"{entry.get("extended", {}).get("md5", "")}"',
+            "Last-Modified": datetime.fromtimestamp(
+                entry.get("mtime", 0), tz=timezone.utc
+            ).strftime("%a, %d %b %Y %H:%M:%S GMT"),
+            "Accept-Ranges": "bytes",
+        }
+        for k, v in entry.get("extended", {}).items():
+            if k.startswith("X-Amz-Meta-"):
+                resp_headers[k] = v
+        rng = headers.get("Range", "")
+        try:
+            status, data, rh = await astream(
+                "GET", self.client._u(opath),
+                headers={"Range": rng} if rng else None,
+            )
+        except Exception:  # noqa: BLE001
+            return NATIVE_FALLBACK
+        if status not in (200, 206) or not isinstance(data, AStreamBody):
+            if hasattr(data, "close"):
+                data.close()
+            return NATIVE_FALLBACK
+        if data.length is None:
+            data.close()
+            return NATIVE_FALLBACK  # unframed upstream: bridge fails loudly
+        if status == 206 and "content-range" in rh:
+            resp_headers["Content-Range"] = rh["content-range"]
+
+        async def pieces(src):
+            try:
+                while True:
+                    chunk = await src.read(1 << 16)
+                    if not chunk:
+                        break
+                    yield chunk
+            finally:
+                src.close()
+                self._req_hist.observe(
+                    time.monotonic() - t0, op="object_get"
+                )
+
+        return status, AsyncStreamBody(data.length, pieces(data)), resp_headers
 
     def _delete_object(self, bucket, key):
         path = self._object_path(bucket, key.rstrip("/"))
@@ -1187,6 +1294,10 @@ class S3ApiServer:
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
             disable_nagle_algorithm = True  # keep-alive + Nagle = ~40ms RTTs
+            trace_service = "s3"
+            # hot GetObject served natively on the loop (aio mode); every
+            # edge falls back to the bridged _go path for canonical bytes
+            native_routes = [("GET", "/", api._get_object_native)]
 
             def log_message(self, fmt, *args):
                 pass

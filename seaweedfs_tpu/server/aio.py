@@ -36,56 +36,109 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import contextvars
+import email.utils
 import io
+import json
+import os
 import socket
 import threading
+import time
+import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
-from seaweedfs_tpu.util import glog
+from seaweedfs_tpu.util import faultpoints, glog
 from seaweedfs_tpu.util.aio_pipeline import ThreadFlume, ThreadFlumeClosed
+from seaweedfs_tpu.util.throttler import GOVERNOR
 
+from ..stats import trace as _trace
 from .http_util import (
+    NATIVE_FALLBACK,
     SERVING,
+    AsyncStreamBody,
+    SendfileBody,
     admission_reject_response,
+    count_qos_decision,
+    dynamic_retry_after,
+    observe_tenant_request,
+    request_tenant,
     serving_watermark,
 )
 
 
 def _aio_workers() -> int:
-    import os
-
     raw = os.environ.get("SWEED_AIO_WORKERS", "32").strip()
     if not (raw.isascii() and raw.isdigit()):
         return 32
     return max(1, int(raw))
 
 
+def _env_seconds(name: str, default: int) -> float:
+    raw = os.environ.get(name, str(default)).strip()
+    if not (raw.isascii() and raw.isdigit()):
+        return float(default)
+    return float(int(raw))
+
+
+def idle_timeout_seconds() -> float:
+    """Reap a connection idle (no request head arriving) this long: the
+    slow-loris defense — a peer dribbling one header byte per minute
+    holds a parked coroutine forever otherwise. 0 disables."""
+    return _env_seconds("SWEED_IDLE_TIMEOUT", 60)
+
+
+def handler_deadline_seconds() -> float:
+    """Reap a connection whose in-flight request exceeds this wall-clock
+    budget. Off by default (0): long-running streams — volume copy,
+    tail-reads — are legitimate; deployments that want a hard ceiling
+    opt in."""
+    return _env_seconds("SWEED_HANDLER_DEADLINE", 0)
+
+
+def reap_interval_seconds() -> float:
+    return max(0.5, _env_seconds("SWEED_REAP_INTERVAL", 5))
+
+
 class _SendfileOp:
     """Ordered zero-copy marker in the response flume: the pump executes
     it with loop.sendfile once every byte queued before it has reached
-    the transport, then wakes the waiting worker thread."""
+    the transport, then wakes the waiter — a worker thread (bridged
+    path, threading.Event) or a native coroutine (loop-side future)."""
 
-    def __init__(self, file, offset: int, count: Optional[int]):
+    def __init__(self, file, offset: int, count: Optional[int],
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
         self.file, self.offset, self.count = file, offset, count
-        self._evt = threading.Event()
+        self._evt = threading.Event() if loop is None else None
+        self._fut = loop.create_future() if loop is not None else None
         self._result = 0
         self._exc: Optional[BaseException] = None
 
     def resolve(self, sent: int) -> None:
         self._result = sent
-        self._evt.set()
+        if self._fut is not None:
+            # the pump runs on the owning loop, so setting directly is safe
+            if not self._fut.done():
+                self._fut.set_result(sent)
+        else:
+            self._evt.set()
 
     def reject(self, exc: BaseException) -> None:
         self._exc = exc
-        self._evt.set()
+        if self._fut is not None:
+            if not self._fut.done():
+                self._fut.set_exception(exc)
+        else:
+            self._evt.set()
 
     def wait(self) -> int:
         self._evt.wait()
         if self._exc is not None:
             raise self._exc
         return self._result
+
+    async def await_sent(self) -> int:
+        return await self._fut
 
 
 class _WfileBridge:
@@ -210,6 +263,89 @@ class _ShimConn:
         return op.wait()
 
 
+# -- native-async fast path ---------------------------------------------------
+class _HeaderView:
+    """Case-insensitive read-only view over parsed request headers — the
+    subset of the email.message surface the reused handler helpers
+    (_auth_ok, classify_tenant, range parsing) actually touch."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, pairs):
+        d = {}
+        for k, v in pairs:
+            d[k.lower()] = v  # duplicates: last wins (hot path only)
+        self._d = d
+
+    def get(self, name, default=None):
+        return self._d.get(name.lower(), default)
+
+    def __contains__(self, name) -> bool:
+        return name.lower() in self._d
+
+    def items(self):
+        return self._d.items()
+
+
+class NativeRequest:
+    """The request surface a native-async route coroutine sees: just
+    enough of the BaseHTTPRequestHandler shape that the sync helpers the
+    hot paths reuse verbatim (_auth_ok, _range_reply, _sendfile_reply's
+    header-population side) run unchanged against it."""
+
+    __slots__ = ("command", "path", "headers", "client_address",
+                 "extra_headers", "close_connection", "server")
+
+    def __init__(self, command: str, path: str, headers: _HeaderView,
+                 client_address: tuple, server):
+        self.command = command
+        self.path = path
+        self.headers = headers
+        self.client_address = client_address
+        self.extra_headers: Optional[dict] = None
+        self.close_connection = False
+        self.server = server
+
+
+def _parse_head_headers(rest: bytes) -> Optional[_HeaderView]:
+    """Header block (bytes after the request line) → view, or None when
+    malformed (native punts; the bridged parser owns the error bytes)."""
+    pairs = []
+    try:
+        for line in rest.decode("latin-1").split("\r\n"):
+            if not line:
+                continue
+            k, sep, v = line.partition(":")
+            if not sep or not k or k != k.strip():
+                return None
+            pairs.append((k, v.strip()))
+    except UnicodeDecodeError:  # latin-1 never raises; defensive
+        return None
+    return _HeaderView(pairs)
+
+
+_RESPONSE_PHRASES = BaseHTTPRequestHandler.responses
+
+
+def _native_response_head(handler_cls, status: int,
+                          headers: list) -> bytes:
+    """Response head byte-compatible with BaseHTTPRequestHandler's
+    send_response (same status phrase, Server and Date headers) so
+    threads-vs-native wire parity holds for everything a client can
+    key on."""
+    phrase = _RESPONSE_PHRASES.get(status, ("", ""))[0]
+    server = (f"{handler_cls.server_version} "
+              f"{BaseHTTPRequestHandler.sys_version}")
+    lines = [
+        f"HTTP/1.1 {status} {phrase}",
+        f"Server: {server}",
+        f"Date: {email.utils.formatdate(usegmt=True)}",
+    ]
+    for k, v in headers:
+        lines.append(f"{k}: {v}")
+    return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+
+
 def _expect_100_and_flush(h) -> bool:
     """handle_expect_100 writes '100 Continue' into a buffering wfile;
     the interim response must hit the wire before the client will send
@@ -286,6 +422,19 @@ class AioHTTPServer:
         # loop-confined: every mutation happens on the loop thread
         self._conns: set = set()
         self._conn_tasks: set = set()
+        # writer → [phase, deadline, task] for the reaper ("idle" while
+        # waiting on a request head, "handler" while one is in flight)
+        self._conn_meta: dict = {}
+        # (method, prefix) → coroutine for the native fast path; route
+        # SELECTION still walks handler_cls.routes in order so a native
+        # prefix can never shadow a longer bridged one
+        self._native_map = {
+            (m, p): fn
+            for m, p, fn in getattr(handler_cls, "native_routes", [])
+        }
+        self._native_list = list(
+            getattr(handler_cls, "native_routes", [])
+        )
         SERVING.register_server(self)
 
     # -- socketserver-compatible surface ------------------------------------
@@ -355,9 +504,11 @@ class AioHTTPServer:
         addr = server.sockets[0].getsockname()
         self.server_address = (addr[0], addr[1])
         lag = asyncio.ensure_future(self._lag_monitor())
+        reaper = asyncio.ensure_future(self._reaper())
         self._ready.set()
         await self._stop_evt.wait()
         lag.cancel()
+        reaper.cancel()
         server.close()
         await server.wait_closed()
         # sever live keep-alive connections, same contract as the
@@ -366,6 +517,28 @@ class AioHTTPServer:
             task.cancel()
         if self._conn_tasks:
             await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+
+    async def _reaper(self) -> None:
+        """Deadline-aware connection reaper: kills slow-loris peers (an
+        "idle" connection is one that owes us a request head — a
+        half-dribbled head still counts as idle) and, when a handler
+        deadline is configured, requests stuck in flight. Reaping
+        cancels the connection task; its finally-block teardown closes
+        the transport and any in-flight extent fds."""
+        while True:
+            await asyncio.sleep(reap_interval_seconds())
+            now = self._loop.time()
+            for writer, meta in list(self._conn_meta.items()):
+                phase, deadline, task = meta
+                if deadline is None or now <= deadline:
+                    continue
+                self._conn_meta.pop(writer, None)
+                SERVING.note_reaped(
+                    "idle" if phase == "idle" else "deadline"
+                )
+                glog.V(1).info("reaping %s connection past deadline",
+                               phase)
+                task.cancel()
 
     async def _lag_monitor(self) -> None:
         """Publish scheduled-vs-ran delta: how late a timer fires is how
@@ -438,8 +611,16 @@ class AioHTTPServer:
         wfile = _WfileBridge(flume)
         conn = _ShimConn(rfile, flume)
         peer = writer.get_extra_info("peername") or ("", 0)
+        client_address = (peer[0], peer[1] if len(peer) > 1 else 0)
+        idle_to = idle_timeout_seconds()
+        hdl_to = handler_deadline_seconds()
+        meta = ["idle", None, asyncio.current_task()]
+        self._conn_meta[writer] = meta
         try:
             while True:
+                meta[0] = "idle"
+                meta[1] = (self._loop.time() + idle_to) if idle_to > 0 \
+                    else None
                 try:
                     head = await reader.readuntil(b"\r\n\r\n")
                 except asyncio.IncompleteReadError:
@@ -454,9 +635,23 @@ class AioHTTPServer:
                     break
                 except (ConnectionError, OSError):
                     break
+                meta[0] = "handler"
+                meta[1] = (self._loop.time() + hdl_to) if hdl_to > 0 \
+                    else None
                 idx = head.find(b"\r\n")
                 raw_requestline = head[: idx + 2]
                 rfile.set_head(head[idx + 2:])
+                try:
+                    native_close = await self._maybe_native(
+                        raw_requestline, head[idx + 2:], client_address,
+                        flume, pump,
+                    )
+                except (ConnectionError, OSError):
+                    break  # peer tore the socket mid-reply (RST): done
+                if native_close is not NATIVE_FALLBACK:
+                    if native_close:
+                        break
+                    continue
                 try:
                     # run_in_executor does NOT propagate contextvars (only
                     # task creation copies context) — copy explicitly so
@@ -467,8 +662,7 @@ class AioHTTPServer:
                     close = await self._loop.run_in_executor(
                         self._pool, ctx.run, _run_request,
                         self.handler_cls, self, conn, rfile, wfile,
-                        (peer[0], peer[1] if len(peer) > 1 else 0),
-                        raw_requestline,
+                        client_address, raw_requestline,
                     )
                 except RuntimeError:
                     break  # worker pool already shut down: server stopping
@@ -491,11 +685,249 @@ class AioHTTPServer:
                 await pump
             except BaseException:  # sweedlint: ok broad-except pump already poisoned the flume; connection is closing
                 pass
+            self._conn_meta.pop(writer, None)
             self._conns.discard(writer)
             try:
                 writer.close()
             except Exception:  # sweedlint: ok broad-except transport may already be gone
                 pass
+
+    # -- native fast path ----------------------------------------------------
+    def _native_route(self, method: str, path: str):
+        """The native coroutine for (method, path), or None. Selection
+        walks handler_cls.routes in ORDER — the same route the bridged
+        path would take — so a native ("GET", "/") can never shadow a
+        longer bridged prefix like "/status". Handler classes without a
+        routes table (the S3 gateway) match native_routes directly."""
+        routes = getattr(self.handler_cls, "routes", None)
+        if routes:
+            for m, prefix, _fn in routes:
+                if m == method and path.startswith(prefix):
+                    fn = self._native_map.get((m, prefix))
+                    return (fn, prefix) if fn is not None else None
+            return None
+        for m, prefix, fn in self._native_list:
+            if m == method and path.startswith(prefix):
+                return fn, prefix
+        return None
+
+    async def _maybe_native(self, raw_requestline: bytes,
+                            head_rest: bytes, client_address: tuple,
+                            flume, pump):
+        """Serve the request natively on the loop when a native route
+        matches and the request is plain (no body, no Expect, clean
+        HTTP/1.1). Returns NATIVE_FALLBACK to run the bridged path —
+        which re-parses from the untouched head buffer, so falling back
+        costs nothing and cannot drift — else close_connection."""
+        if not self._native_map and not self._native_list:
+            return NATIVE_FALLBACK
+        if faultpoints.active():
+            # chaos parity: fault kinds like delay/serial-delay block;
+            # the bridged worker path absorbs them off the loop
+            return NATIVE_FALLBACK
+        try:
+            rl = raw_requestline.decode("latin-1").rstrip("\r\n")
+            method, target, version = rl.split(" ")
+        except ValueError:
+            return NATIVE_FALLBACK
+        if version != "HTTP/1.1" or not target.startswith("/"):
+            return NATIVE_FALLBACK
+        parsed = urllib.parse.urlsplit(target)
+        hit = self._native_route(method, parsed.path)
+        if hit is None:
+            return NATIVE_FALLBACK
+        headers = _parse_head_headers(head_rest)
+        if headers is None:
+            return NATIVE_FALLBACK
+        if "Expect" in headers or "Transfer-Encoding" in headers:
+            return NATIVE_FALLBACK
+        cl = (headers.get("Content-Length") or "0").strip() or "0"
+        if not (cl.isascii() and cl.isdigit()) or int(cl) != 0:
+            return NATIVE_FALLBACK
+        return await self._native_dispatch(
+            hit[0], hit[1], method, parsed, headers, client_address,
+            flume, pump,
+        )
+
+    async def _native_dispatch(self, fn, prefix: str, method: str,
+                               parsed, headers, client_address: tuple,
+                               flume, pump):
+        tenant = request_tenant(headers, client_address[0])
+        decision, wait = GOVERNOR.admit(tenant)
+        if decision == "shed":
+            # keep-alive survives a shed: forcing a close turns every
+            # over-rate request into an accept + task churn on THIS loop,
+            # which hurts compliant tenants more than the abuser. Socket
+            # abuse is the reaper's and the watermark's job.
+            count_qos_decision(tenant, "shed")
+            body = json.dumps({"error": "tenant over rate"}).encode()
+            head = _native_response_head(self.handler_cls, 503, [
+                ("Content-Type", "application/json"),
+                ("Content-Length", str(len(body))),
+                ("Retry-After", str(dynamic_retry_after())),
+            ])
+            try:
+                await flume.aput(head + body)
+            except ThreadFlumeClosed:
+                pass
+            return False
+        if decision == "delay":
+            count_qos_decision(tenant, "delay")
+            await asyncio.sleep(wait)
+        elif GOVERNOR.enabled() and tenant != "internal":
+            count_qos_decision(tenant, "ok")
+        t0 = time.monotonic()
+        query = {
+            k: v[0]
+            for k, v in urllib.parse.parse_qs(parsed.query).items()
+        }
+        req = NativeRequest(method, parsed.path, headers,
+                            client_address, self)
+        # the span CM is task-scoped contextvars — safe in a coroutine
+        with _trace.start_span(
+            f"{method} {prefix}",
+            service=getattr(self.handler_cls, "trace_service", "http"),
+            parent_header=headers.get(_trace.TRACE_HEADER),
+            path=parsed.path,
+        ) as span:
+            try:
+                result = await fn(req, parsed.path, query)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # nothing has been written yet: the bridged path re-runs
+                # the request and produces its canonical error bytes
+                glog.exception("native %s %s failed; bridging",
+                               method, parsed.path)
+                SERVING.note_native_fallback()
+                return NATIVE_FALLBACK
+            if result is NATIVE_FALLBACK:
+                SERVING.note_native_fallback()
+                return NATIVE_FALLBACK
+            status, payload = result[0], result[1]
+            extra = dict(req.extra_headers or {})
+            if len(result) > 2 and result[2]:
+                extra.update(result[2])
+            if span is not None:
+                span.tags["status"] = status
+                if status >= 500:
+                    span.status = "error"
+                extra.setdefault(_trace.TRACE_ID_HEADER, span.trace_id)
+            close = (
+                req.close_connection
+                or (headers.get("Connection") or "").lower() == "close"
+            )
+            close = await self._write_native(
+                status, payload, extra, flume, pump,
+                head_only=(method == "HEAD"), close=close,
+            )
+        dt = time.monotonic() - t0
+        SERVING.note_native()
+        SERVING.note_request_seconds(dt)
+        observe_tenant_request(tenant, dt)
+        glog.V(2).info("%s %s → %d (native)", method, parsed.path,
+                       status)
+        return close
+
+    async def _write_native(self, status: int, payload, extra: dict,
+                            flume, pump, head_only: bool,
+                            close: bool) -> bool:
+        """Format and queue a native response through the connection's
+        flume — the SAME ordered channel bridged responses ride, so a
+        keep-alive connection can interleave bridged and native requests
+        without byte reordering. Returns close_connection."""
+        if isinstance(payload, SendfileBody):
+            body_bytes = None
+            default_clen = str(payload.count)
+            default_ctype = "application/octet-stream"
+        elif isinstance(payload, AsyncStreamBody):
+            body_bytes = None
+            default_clen = str(payload.length)
+            default_ctype = "application/octet-stream"
+        elif isinstance(payload, (bytes, bytearray)):
+            body_bytes = bytes(payload)
+            default_clen = str(len(body_bytes))
+            default_ctype = "application/octet-stream"
+        else:
+            body_bytes = json.dumps(payload).encode()
+            default_clen = str(len(body_bytes))
+            default_ctype = "application/json"
+        hdr_list = [
+            ("Content-Type", extra.pop("Content-Type", default_ctype)),
+            ("Content-Length",
+             extra.pop("Content-Length", default_clen)),
+        ]
+        hdr_list.extend(extra.items())
+        if self.overloaded():
+            hdr_list.append(("Connection", "close"))
+            close = True
+            SERVING.note_keepalive_shed()
+        head = _native_response_head(self.handler_cls, status, hdr_list)
+        try:
+            if isinstance(payload, SendfileBody):
+                try:
+                    await flume.aput(head)
+                    if head_only:
+                        return close
+                    op = _SendfileOp(payload.file, payload.offset,
+                                     payload.count, loop=self._loop)
+                    await flume.aput(op)
+                    # the pump resolves the op; if the pump dies first
+                    # (peer reset → close_read drops queued items), the
+                    # wait below unblocks on the pump instead of hanging
+                    await asyncio.wait(
+                        {op._fut, pump},
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    if not op._fut.done():
+                        return True  # client gone mid-queue
+                    sent = await op._fut  # already done: resolves inline
+                finally:
+                    # the extent fd closes on EVERY exit: completion,
+                    # client death, reaper cancellation mid-sendfile
+                    payload.close()
+                if sent != payload.count:
+                    glog.error("native sendfile produced %d of %d bytes",
+                               sent, payload.count)
+                    return True
+                return close
+            if isinstance(payload, AsyncStreamBody):
+                gen = payload.chunks
+                sent = 0
+                try:
+                    await flume.aput(head)
+                    if head_only:
+                        return close
+                    async for piece in gen:
+                        await flume.aput(piece)
+                        sent += len(piece)
+                except asyncio.CancelledError:
+                    raise
+                except ThreadFlumeClosed:
+                    return True
+                except Exception:
+                    glog.exception(
+                        "native stream reply failed after %d/%d bytes",
+                        sent, payload.length)
+                    return True
+                finally:
+                    aclose = getattr(gen, "aclose", None)
+                    if aclose is not None:
+                        try:
+                            await aclose()
+                        except Exception:  # sweedlint: ok broad-except generator already failed; nothing to report
+                            pass
+                if sent != payload.length:
+                    glog.error("native stream produced %d of %d bytes",
+                               sent, payload.length)
+                    return True
+                return close
+            await flume.aput(head if head_only else head + body_bytes)
+            return close
+        except ThreadFlumeClosed:
+            if isinstance(payload, SendfileBody):
+                payload.close()
+            return True
 
     async def _canned(self, flume, pump, writer, payload: bytes) -> None:
         """Loop-originated error response: let the pump finish what is

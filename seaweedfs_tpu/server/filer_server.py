@@ -1093,6 +1093,162 @@ class FilerServer:
 
         return timed()
 
+    async def _afetch_chunk(self, file_id: str, url: str) -> bytes:
+        """Async mirror of _fetch_chunk for the native read path: one
+        volume url (the caller resolved it from the cached vid map), the
+        loop's pooled keep-alive transport, cache-aside ciphertext."""
+        data = self.chunk_cache.get(file_id)
+        if data is not None:
+            return data
+        from ..security import read_auth_query
+        from . import aio_transport
+
+        auth = read_auth_query(self.jwt_read_key, file_id)
+        status, body, _ = await aio_transport.request(
+            "GET", f"http://{url}/{file_id}{auth}"
+        )
+        if status != 200:
+            raise ConnectionError(f"chunk {file_id}: HTTP {status}")
+        self.chunk_cache.put(file_id, body)
+        return body
+
+    async def _astream_range(self, views, urls: dict, offset: int,
+                             size: int):
+        """Async generator of body pieces for [offset, offset+size) —
+        the native mirror of _stream_range's produce(): aprefetch_iter
+        drives up to ``read_window`` chunk fetches concurrently ON the
+        loop, pieces yield strictly in view order, sparse gaps stream as
+        bounded zero blocks, and a two-slot plaintext memo bounds
+        re-decryption. Byte-for-byte identical to the bridged stream."""
+        from collections import OrderedDict
+
+        from ..util.aio_pipeline import aprefetch_iter
+
+        end = offset + size
+        window = self.read_window
+        if len({v.file_id for v in views}) <= 1:
+            window = 1
+        pos = offset
+        memo: OrderedDict[str, bytes] = OrderedDict()
+        t0 = time.perf_counter()
+        fetched = aprefetch_iter(
+            views,
+            lambda v: self._afetch_chunk(v.file_id, urls[v.file_id]),
+            window,
+            key=lambda v: v.file_id,  # single-flight per fid
+        )
+        try:
+            async for view, raw in fetched:
+                data = memo.get(view.file_id)
+                if data is None:
+                    data = raw
+                    if view.cipher_key:
+                        from ..util import cipher as cipher_mod
+
+                        data = cipher_mod.decrypt(
+                            data, base64.b64decode(view.cipher_key)
+                        )
+                    memo[view.file_id] = data
+                    while len(memo) > 2:
+                        memo.popitem(last=False)
+                if view.logic_offset > pos:  # sparse gap
+                    gap = view.logic_offset - pos
+                    while gap > 0:
+                        n = min(self._ZERO_PIECE, gap)
+                        yield b"\x00" * n
+                        gap -= n
+                        pos += n
+                piece = data[view.offset : view.offset + view.size]
+                if piece:
+                    yield piece
+                    pos += len(piece)
+            tail = end - pos
+            while tail > 0:
+                n = min(self._ZERO_PIECE, tail)
+                yield b"\x00" * n
+                tail -= n
+        finally:
+            # close-without-wait on client-gone lives inside
+            # aprefetch_iter's finally; here only the latency record
+            self._req_hist.observe(
+                time.perf_counter() - t0, op="read_stream"
+            )
+
+    async def _h_read_native(self, h, path, q):
+        """Native-async filer GET: find_entry is a local metadata read,
+        the filer→volume hop rides the asyncio pooled transport, and
+        chunk read-ahead runs natively on the loop. Edges fall back to
+        the bridged _h_read for canonical bytes: meta=true, directories,
+        chunk manifests (resolution does sync chunk reads), 404/416
+        rendering, and volume locations not yet in the cached vid map
+        (the bridged path does the master round-trip that populates it).
+        """
+        from .http_util import (
+            NATIVE_FALLBACK,
+            AsyncStreamBody,
+            parse_byte_range,
+            range_headers,
+        )
+
+        if q.get("meta") == "true":
+            return NATIVE_FALLBACK
+        t0 = time.perf_counter()
+        lookup = urllib.parse.unquote(path).rstrip("/") or "/"
+        try:
+            entry = self.filer.find_entry(lookup)
+        except NotFoundError:
+            return NATIVE_FALLBACK  # bridge renders the canonical 404
+        if entry.is_directory:
+            return NATIVE_FALLBACK
+        from ..filer.filechunk_manifest import has_chunk_manifest
+
+        chunks = list(entry.chunks)
+        if has_chunk_manifest(chunks):
+            return NATIVE_FALLBACK
+        total = entry.file_size()
+        offset, size = 0, total
+        rng = h.headers.get("Range", "")
+        parsed = parse_byte_range(rng, total) if rng else None
+        if parsed == "unsatisfiable":
+            return NATIVE_FALLBACK  # canonical 416 body stays bridged
+        if parsed is not None:
+            start, end = parsed
+            offset, size = start, end - start + 1
+        views = view_from_chunks(chunks, offset, size)
+        # every chunk's volume must already be in the pushed vid map —
+        # a miss would cost a sync master round-trip on the loop
+        from ..storage.file_id import FileId
+
+        vid_map = self._master_client.vid_map
+        urls: dict[str, str] = {}
+        for v in views:
+            if v.file_id in urls:
+                continue
+            url = vid_map.lookup_volume_url(
+                FileId.parse(v.file_id).volume_id
+            )
+            if url is None:
+                return NATIVE_FALLBACK
+            urls[v.file_id] = url
+        if views:
+            # eager first chunk, like _stream_range's eager first piece:
+            # a down volume surfaces as a bridged 500, not a truncated
+            # native 200 (and the fetch lands in chunk_cache either way)
+            try:
+                await self._afetch_chunk(
+                    views[0].file_id, urls[views[0].file_id]
+                )
+            except Exception:  # noqa: BLE001 — bridge retries all replicas
+                return NATIVE_FALLBACK
+        body = AsyncStreamBody(
+            size, self._astream_range(views, urls, offset, size)
+        )
+        self._req_hist.observe(time.perf_counter() - t0, op="read")
+        if parsed is not None:
+            h.extra_headers = range_headers(offset, offset + size - 1, total)
+            return 206, body
+        return 200, body
+
     def _read_range(self, entry: Entry, offset: int, size: int) -> bytes:
         """StreamContent (filer/stream.go:16): chunk views → volume reads.
 
@@ -1178,6 +1334,11 @@ class FilerServer:
                 ("POST", "/", fs._h_write_stream),
                 ("PUT", "/", fs._h_write_stream),
                 ("DELETE", "/", fs._h_delete),
+            ]
+            # hot file reads served natively on the loop; every edge
+            # falls back to the bridged _h_read above for canonical bytes
+            native_routes = [
+                ("GET", "/", fs._h_read_native),
             ]
 
         self._srv = start_server(Handler, self.host, self.port)

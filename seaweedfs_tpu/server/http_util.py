@@ -5,7 +5,9 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import weakref
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
@@ -13,29 +15,59 @@ import urllib.parse
 import urllib.request
 
 from seaweedfs_tpu.util import glog
+from seaweedfs_tpu.util.throttler import (
+    GOVERNOR,
+    INTERNAL_HEADER,
+    INTERNAL_TENANT,
+    classify_tenant,
+)
 
 from ..stats import trace as _trace
+
+# Flipped by start_server(): a process that serves cluster traffic marks
+# its OUTBOUND pooled-transport requests with X-Sweed-Internal, so
+# intra-cluster hops (filer→volume chunk fetches, replication fan-out,
+# heartbeats) bypass the tenant governor — throttling replication under a
+# misconfigured QoS budget would turn a knob into a durability incident.
+# The header is trusted exactly as far as intra-cluster JWT-less auth
+# already is (a private network); see docs/OBSERVABILITY.md.
+_cluster_process = False
+
+
+def mark_cluster_process() -> None:
+    global _cluster_process
+    _cluster_process = True
 
 
 def _trace_headers(headers: Optional[dict]) -> Optional[dict]:
     """Outbound header injection point for EVERY internal HTTP call: when
     a span is active on this thread, the request carries
     ``X-Sweed-Trace: <trace_id>:<span_id>`` so the receiving daemon's
-    server span joins the caller's tree. The original dict is never
-    mutated; an explicit caller-set trace header wins."""
+    server span joins the caller's tree; daemon processes additionally
+    stamp ``X-Sweed-Internal`` (tenant-governor bypass). The original
+    dict is never mutated; explicit caller-set headers win."""
     hv = _trace.inject_header()
-    if hv is None:
+    if hv is None and not _cluster_process:
         return headers
     out = dict(headers or {})
-    out.setdefault(_trace.TRACE_HEADER, hv)
+    if hv is not None:
+        out.setdefault(_trace.TRACE_HEADER, hv)
+    if _cluster_process:
+        out.setdefault(INTERNAL_HEADER, "1")
     return out
 
 
 # -- serving-core shared state ------------------------------------------------
 def serving_mode() -> str:
-    """'aio' or 'threads' — which serving core start_server builds."""
-    mode = os.environ.get("SWEED_SERVING", "threads").strip().lower()
-    return "aio" if mode == "aio" else "threads"
+    """'aio' or 'threads' — which serving core start_server builds.
+
+    The event-loop reactor is the DEFAULT: idle connections park on the
+    loop, hot read routes run native (no worker-thread hop), and the
+    bridged worker pool serves everything else byte-identically.
+    ``SWEED_SERVING=threads`` is the escape hatch back to classic
+    thread-per-connection (see docs/PERF.md migration note)."""
+    mode = os.environ.get("SWEED_SERVING", "aio").strip().lower()
+    return "threads" if mode == "threads" else "aio"
 
 
 def serving_watermark() -> int:
@@ -50,10 +82,29 @@ def serving_watermark() -> int:
 
 
 def retry_after_seconds() -> int:
+    """BASE Retry-After on shed 503s; see dynamic_retry_after for the
+    live-pressure scaling that goes on the wire."""
     raw = os.environ.get("SWEED_RETRY_AFTER", "1").strip()
     if not (raw.isascii() and raw.isdigit()):
         return 1
     return max(1, int(raw))
+
+
+def dynamic_retry_after() -> int:
+    """Retry-After derived from live pressure, not a constant: scale the
+    base by the inflight/watermark load ratio and the current request
+    p99, so a storm's retries spread out proportionally to how far past
+    capacity the gateway actually is (a constant value re-synchronizes
+    every shed client into the next thundering herd). Clamped to
+    [base, 60]; degrades to the base when the watermark is off or no
+    latency samples exist yet."""
+    base = retry_after_seconds()
+    wm = serving_watermark()
+    if wm <= 0:
+        return base
+    load = SERVING.inflight() / wm
+    val = base + int(load * (base + 2.0 * SERVING.request_p99()))
+    return max(base, min(val, 60))
 
 
 def sendfile_min_bytes() -> Optional[int]:
@@ -77,7 +128,7 @@ def admission_reject_response() -> bytes:
     request on it."""
     return (
         "HTTP/1.1 503 Service Unavailable\r\n"
-        f"Retry-After: {retry_after_seconds()}\r\n"
+        f"Retry-After: {dynamic_retry_after()}\r\n"
         "Content-Length: 0\r\n"
         "Connection: close\r\n\r\n"
     ).encode("ascii")
@@ -99,6 +150,12 @@ class _ServingState:
         self._assign_batches = 0
         self._assign_fids = 0
         self._assign_max_batch = 0
+        # recent request service times (seconds); feeds dynamic_retry_after
+        self._lat_ring: deque = deque(maxlen=256)
+        self._reaped = {"idle": 0, "deadline": 0}
+        self._native_hits = 0
+        self._native_fallbacks = 0
+        self._qos = {"ok": 0, "delay": 0, "shed": 0}
 
     def register_server(self, srv) -> None:
         with self._lock:
@@ -137,6 +194,36 @@ class _ServingState:
             if n > self._assign_max_batch:
                 self._assign_max_batch = n
 
+    def note_request_seconds(self, seconds: float) -> None:
+        with self._lock:
+            self._lat_ring.append(seconds)
+
+    def request_p99(self) -> float:
+        with self._lock:
+            return self._p99_locked()
+
+    def _p99_locked(self) -> float:
+        if not self._lat_ring:
+            return 0.0
+        ring = sorted(self._lat_ring)
+        return ring[min(len(ring) - 1, int(len(ring) * 0.99))]
+
+    def note_reaped(self, phase: str) -> None:
+        with self._lock:
+            self._reaped[phase] = self._reaped.get(phase, 0) + 1
+
+    def note_native(self) -> None:
+        with self._lock:
+            self._native_hits += 1
+
+    def note_native_fallback(self) -> None:
+        with self._lock:
+            self._native_fallbacks += 1
+
+    def note_qos(self, outcome: str) -> None:
+        with self._lock:
+            self._qos[outcome] = self._qos.get(outcome, 0) + 1
+
     def snapshot(self) -> dict:
         with self._lock:
             batches = self._assign_batches
@@ -154,6 +241,14 @@ class _ServingState:
                 "assign_avg_batch": round(
                     self._assign_fids / batches, 2
                 ) if batches else 0.0,
+                "request_p99_ms": round(self._p99_locked() * 1000.0, 3),
+                "reaped_idle": self._reaped.get("idle", 0),
+                "reaped_deadline": self._reaped.get("deadline", 0),
+                "native_hits": self._native_hits,
+                "native_fallbacks": self._native_fallbacks,
+                "qos_ok": self._qos.get("ok", 0),
+                "qos_delayed": self._qos.get("delay", 0),
+                "qos_shed": self._qos.get("shed", 0),
             }
 
     def inflight_unlocked_sum(self) -> int:
@@ -293,6 +388,61 @@ class SendfileBody:
             pass
 
 
+class AsyncStreamBody:
+    """Native-handler return value for incrementally-produced bodies:
+    ``length`` goes in Content-Length, ``chunks`` (an ASYNC iterable of
+    bytes) is written piece by piece on the event loop — the native
+    mirror of StreamBody."""
+
+    def __init__(self, length: int, chunks):
+        self.length = length
+        self.chunks = chunks
+
+
+#: Sentinel a native-async route coroutine returns to punt the request to
+#: the bridged worker-thread path, which re-runs the untouched handler
+#: class — byte-identical legacy behavior by construction. Native handlers
+#: implement ONLY the happy hot path; every auth failure, error, or
+#: exotic request shape falls back.
+NATIVE_FALLBACK = object()
+
+
+def request_tenant(headers, remote_addr: str) -> str:
+    """Tenant key for a request, given any case-insensitive headers
+    mapping (http.client message or the native path's view)."""
+    return classify_tenant(
+        lambda k, d="": (headers.get(k) or d), remote_addr
+    )
+
+
+def observe_tenant_request(tenant: str, seconds: float) -> None:
+    """Per-tenant latency evidence for /metrics quantiles. Recorded when
+    the tenant is explicit (header / access key) or the governor is on —
+    anonymous /24 classes only get labeled samples while QoS is active,
+    which bounds label cardinality in the common single-tenant case."""
+    if tenant == INTERNAL_TENANT:
+        return
+    if not (GOVERNOR.enabled() or not tenant.startswith("ip:")):
+        return
+    try:
+        from ..stats import metrics as _metrics
+
+        _metrics.note_qos_request(tenant, seconds)
+    except Exception:  # sweedlint: ok broad-except metrics must never break serving
+        pass
+
+
+def count_qos_decision(tenant: str, outcome: str) -> None:
+    """Shed/delay/ok counters, per tenant, for /metrics."""
+    SERVING.note_qos(outcome)
+    try:
+        from ..stats import metrics as _metrics
+
+        _metrics.note_qos_decision(tenant, outcome)
+    except Exception:  # sweedlint: ok broad-except metrics must never break serving
+        pass
+
+
 def has_dot_segments(path: str) -> bool:
     """True when any "/"-separated segment is literally "." or "..".
 
@@ -332,6 +482,12 @@ class JsonHandler(BaseHTTPRequestHandler):
 
     protocol_version = "HTTP/1.1"
     routes: list[tuple[str, str, Callable]] = []
+    # Native-async fast-path routes, served directly on the aio reactor's
+    # loop (no worker-thread hop): [(method, path_prefix, coroutine)]
+    # where the coroutine takes a NativeRequest (server/aio.py) and
+    # returns NATIVE_FALLBACK or (status, payload[, extra_headers]).
+    # Threads mode ignores these entirely.
+    native_routes: list[tuple[str, str, Callable]] = []
     server_ctx: Any = None
     extra_headers: Optional[dict] = None  # handlers may set per-request
     # span service tag for this daemon's server spans ("master", "filer",
@@ -360,6 +516,27 @@ class JsonHandler(BaseHTTPRequestHandler):
             self.close_connection = True
             self._reply(400, {"error": "bad Content-Length"})
             return
+        # Per-tenant admission: a tenant past its weighted-fair share is
+        # paced (short sleep on this worker thread), then shed with
+        # 503 + dynamic Retry-After. Internal cluster hops bypass. The
+        # connection stays OPEN on shed: forcing a close makes the abuser
+        # reconnect, and the accept/teardown churn costs the server more
+        # than the abuser — socket-level abuse is the reaper's and the
+        # keep-alive watermark's job, not the governor's.
+        tenant = request_tenant(self.headers, self.client_address[0])
+        decision, wait = GOVERNOR.admit(tenant)
+        if decision == "shed":
+            count_qos_decision(tenant, "shed")
+            self.extra_headers = dict(self.extra_headers or {})
+            self.extra_headers["Retry-After"] = str(dynamic_retry_after())
+            self._reply(503, {"error": "tenant over rate"})
+            return
+        if decision == "delay":
+            count_qos_decision(tenant, "delay")
+            time.sleep(wait)
+        elif GOVERNOR.enabled() and tenant != INTERNAL_TENANT:
+            count_qos_decision(tenant, "ok")
+        t0 = time.monotonic()
         body = None  # read lazily: streaming handlers consume rfile directly
         for m, prefix, fn in self.routes:
             if m == method and parsed.path.startswith(prefix):
@@ -418,6 +595,9 @@ class JsonHandler(BaseHTTPRequestHandler):
                             )
                     glog.V(2).info("%s %s → %d", method, parsed.path, status)
                     self._reply(status, payload, head_only=(method == "HEAD"))
+                    dt = time.monotonic() - t0
+                    SERVING.note_request_seconds(dt)
+                    observe_tenant_request(tenant, dt)
                 return
         if body is None and length:
             # drain in bounded pieces for keep-alive correctness — a multi-GB
@@ -628,6 +808,12 @@ class _TrackingThreadingHTTPServer(ThreadingHTTPServer):
     connections (handler threads block in readline forever) — clients
     with pooled connections then talk to a ghost."""
 
+    # socketserver's default listen backlog is 5: a modest connection
+    # burst (the c=256 probe smoke, or any pooled client warming up)
+    # overflows it and the kernel drops SYNs. Match the aio reactor's
+    # backlog so the escape-hatch core survives the same storms.
+    request_queue_size = 2048
+
     def __init__(self, *a, **k):
         super().__init__(*a, **k)
         self._live_conns: set = set()
@@ -691,6 +877,9 @@ def start_server(handler_cls, host: str, port: int, ssl_context=None):
     runs the exact same handler code but parks idle connections on the
     event loop instead of spending a thread each. Both expose
     shutdown()/server_close()/server_address and admission control."""
+    # serving cluster traffic ⇒ this process's outbound calls are
+    # intra-cluster hops (tenant-governor bypass; see _trace_headers)
+    mark_cluster_process()
     if serving_mode() == "aio":
         from .aio import AioHTTPServer
 
@@ -758,6 +947,30 @@ def start_server(handler_cls, host: str, port: int, ssl_context=None):
 _pool_local = threading.local()
 
 
+def pool_max_idle_seconds() -> float:
+    """Max idle age for a pooled keep-alive socket (0 disables reaping).
+
+    Long-lived daemons otherwise accumulate sockets their peers closed
+    hours ago: the stale-probe only catches a peer whose FIN already
+    arrived, and the one-shot retry burns a round trip re-dialing. An
+    idle-age ceiling (default comfortably under typical server
+    keep-alive timeouts) retires old sockets BEFORE the race can
+    happen. The aio pool (server/aio_transport.py) applies the same
+    policy from day one."""
+    raw = os.environ.get("SWEED_POOL_IDLE_S", "30").strip()
+    if not (raw.isascii() and raw.isdigit()):
+        return 30.0
+    return float(int(raw))
+
+
+def _conn_idle_expired(conn) -> bool:
+    max_idle = pool_max_idle_seconds()
+    if max_idle <= 0:
+        return False
+    since = getattr(conn, "_sweed_idle_since", None)
+    return since is not None and (time.monotonic() - since) > max_idle
+
+
 class _NoDelayHTTPConnection:
     """Created lazily to keep module import light."""
 
@@ -811,6 +1024,10 @@ def _pooled_request(
     last_err: Optional[Exception] = None
     for attempt in (0, 1):
         conn = conns.get(key)
+        if conn is not None and _conn_idle_expired(conn):
+            conn.close()
+            conns.pop(key, None)
+            conn = None
         fresh = conn is None
         if fresh:
             conn = _NoDelayHTTPConnection.get()(
@@ -827,6 +1044,8 @@ def _pooled_request(
             if resp.will_close:
                 conn.close()
                 conns.pop(key, None)
+            else:
+                conn._sweed_idle_since = time.monotonic()
             return resp.status, data, resp_headers
         except (
             http.client.RemoteDisconnected,
@@ -878,7 +1097,7 @@ def _checkout_conn(key: tuple, timeout: float):
     if conns is None:
         conns = _pool_local.conns = {}
     conn = conns.pop(key, None)
-    if conn is not None and _conn_is_stale(conn):
+    if conn is not None and (_conn_idle_expired(conn) or _conn_is_stale(conn)):
         conn.close()
         conn = None
     if conn is None:
@@ -892,6 +1111,7 @@ def _repool(conn, key: tuple, conns: dict) -> None:
     if key in conns:  # another request pooled its own conn meanwhile
         conn.close()
     else:
+        conn._sweed_idle_since = time.monotonic()
         conns[key] = conn
 
 

@@ -392,6 +392,75 @@ class VolumeServer:
         h.extra_headers = range_headers(start, end, total)
         return 206, data[start : end + 1]
 
+    async def _h_get_native(self, h, path, q):
+        """Native-async hot GET/HEAD: ncache RAM hits and
+        sendfile-qualified extents served directly on the event loop —
+        no worker-thread hop, no userspace byte copy for extents
+        (``loop.sendfile`` rides ``read_volume_needle_extent``'s dup'd
+        fd). Every edge returns NATIVE_FALLBACK so the bridged handler
+        produces the canonical bytes: guard denial, auth failure, resize
+        params, lookup errors (404 rendering), cookie mismatch, cache
+        populate (buffered path owns it), chunk manifests, gzip the
+        client won't take. The fallback re-runs against warm page cache
+        and a warm index, so edges cost one extra metadata pread — the
+        happy path is what C100k concurrency actually exercises."""
+        from .http_util import NATIVE_FALLBACK
+
+        if not self.guard.allowed(h.client_address[0]):
+            return NATIVE_FALLBACK
+        if not self._auth_ok(h, path, q, self.jwt_read_key):
+            return NATIVE_FALLBACK
+        try:
+            vid, nid, cookie = self._parse_fid_path(path)
+        except ValueError:
+            return NATIVE_FALLBACK
+        if tolerant_uint(q.get("width"), None) or tolerant_uint(
+            q.get("height"), None
+        ):
+            return NATIVE_FALLBACK  # resize needs the bytes in userspace
+        t0 = time.monotonic()
+        if self.ncache.enabled:
+            cached = self.ncache.get(vid, nid, cookie)
+            if cached is not None:
+                # same accounting as the bridged RAM hit: the heat
+                # signal must still see the read (mask-free skew input)
+                self._req_count.inc(op="get")
+                self.store.note_volume_read(vid)
+                rng = h.headers.get("Range", "")
+                if rng:
+                    resp = self._range_reply(h, cached, rng)
+                else:
+                    h.extra_headers = {"Accept-Ranges": "bytes"}
+                    resp = (200, cached)
+                self._req_hist.observe(time.monotonic() - t0, op="get")
+                return resp
+        n = Needle(id=nid)
+        try:
+            ext = self._needle_extent(q, vid, n)
+        except Exception:  # noqa: BLE001 — bridge renders canonical 404/500
+            return NATIVE_FALLBACK
+        if ext is None:
+            return NATIVE_FALLBACK  # small needle: buffered path + populate
+        if n.cookie != cookie:
+            ext[0].close()
+            return NATIVE_FALLBACK
+        if (
+            self.ncache.would_cache(ext[2])
+            and not n.is_chunk_manifest
+            and not n.is_compressed
+        ):
+            # populate-on-miss belongs to the bridged buffered path (one
+            # pread of page-cache-hot bytes); the NEXT read is a native
+            # RAM hit
+            ext[0].close()
+            return NATIVE_FALLBACK
+        resp = self._sendfile_reply(h, q, n, ext)
+        if resp is None:
+            return NATIVE_FALLBACK  # manifest / gzip mismatch: buffered
+        self._req_count.inc(op="get")
+        self._req_hist.observe(time.monotonic() - t0, op="get")
+        return resp
+
     def _serve_chunked_manifest(self, h, n, manifest_bytes: bytes):
         """Concatenate a chunked file from its manifest
         (operation/chunked_file.go; served like
@@ -1625,6 +1694,12 @@ class VolumeServer:
                 ("POST", "/", vs._h_post),
                 ("PUT", "/", vs._h_post),
                 ("DELETE", "/", vs._h_delete),
+            ]
+            # hot read path served natively on the loop; every edge
+            # falls back to the bridged _h_get above for canonical bytes
+            native_routes = [
+                ("GET", "/", vs._h_get_native),
+                ("HEAD", "/", vs._h_get_native),
             ]
 
         # Native turbo data plane: the C++ engine owns the public port and

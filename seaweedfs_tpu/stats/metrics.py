@@ -198,6 +198,89 @@ def register_serving_metrics(registry: Optional[Registry] = None) -> None:
 register_serving_metrics()
 
 
+def register_qos_metrics(registry: Optional[Registry] = None) -> dict:
+    """Per-tenant QoS evidence: a labeled latency histogram (quantiles
+    per tenant — the isolation acceptance bar "a misbehaving tenant can't
+    move a compliant tenant's p99" is asserted from these, not from
+    log-greps), a per-tenant admission-decision counter, and gauges over
+    the serving core's reap/native/shed tallies. Tenant keys are bounded:
+    the governor LRU-caps tenants at 1024 and anonymous /24 classes are
+    only labeled while QoS is active (http_util.observe_tenant_request)."""
+
+    def _snap(key):
+        from ..server.http_util import SERVING
+
+        return SERVING.snapshot().get(key, 0)
+
+    reg = registry if registry is not None else default_registry
+    instruments = {
+        "hist": reg.histogram(
+            "sweed_qos_request_seconds",
+            "request service time by tenant",
+        ),
+        "decisions": reg.counter(
+            "sweed_qos_decisions_total",
+            "tenant-governor admissions by tenant and outcome "
+            "(ok / delay / shed)",
+        ),
+    }
+    reg.gauge(
+        "sweed_serving_request_p99_ms",
+        "p99 request service time over the recent ring (feeds Retry-After)",
+    ).set_function(lambda: _snap("request_p99_ms"))
+    reg.gauge(
+        "sweed_serving_reaped_idle_total",
+        "connections reaped for exceeding the idle timeout (slow-loris)",
+    ).set_function(lambda: _snap("reaped_idle"))
+    reg.gauge(
+        "sweed_serving_reaped_deadline_total",
+        "connections reaped for exceeding the handler deadline",
+    ).set_function(lambda: _snap("reaped_deadline"))
+    reg.gauge(
+        "sweed_serving_native_hits_total",
+        "requests served by native-async fast-path handlers (no bridge)",
+    ).set_function(lambda: _snap("native_hits"))
+    reg.gauge(
+        "sweed_serving_native_fallbacks_total",
+        "native-handler requests punted to the bridged worker path",
+    ).set_function(lambda: _snap("native_fallbacks"))
+    reg.gauge(
+        "sweed_serving_qos_shed_total",
+        "requests shed by the tenant governor (503 + Retry-After)",
+    ).set_function(lambda: _snap("qos_shed"))
+    reg.gauge(
+        "sweed_serving_qos_delayed_total",
+        "requests paced by the tenant governor before admission",
+    ).set_function(lambda: _snap("qos_delayed"))
+    return instruments
+
+
+QOS_INSTRUMENTS = register_qos_metrics()
+
+
+def note_qos_request(tenant: str, seconds: float) -> None:
+    """Record one request's service time under its tenant label."""
+    QOS_INSTRUMENTS["hist"].observe(seconds, tenant=tenant)
+
+
+def note_qos_decision(tenant: str, outcome: str) -> None:
+    """Count one governor admission decision (ok / delay / shed)."""
+    QOS_INSTRUMENTS["decisions"].inc(tenant=tenant, outcome=outcome)
+
+
+def qos_quantile(q: float, tenant: str) -> float:
+    """Per-tenant latency quantile straight off the labeled histogram —
+    what bench.py's QoS phase asserts isolation from."""
+    return QOS_INSTRUMENTS["hist"].quantile(q, tenant=tenant)
+
+
+def qos_stats() -> dict:
+    """Snapshot of the tenant governor for /_status."""
+    from ..util.throttler import GOVERNOR
+
+    return GOVERNOR.snapshot()
+
+
 def serving_stats() -> dict:
     """Snapshot of the serving-core counters for /_status."""
     from ..server.http_util import SERVING

@@ -183,6 +183,13 @@ class ThreadFlume:
     are dropped and producers unblock into ``ThreadFlumeClosed``.
     """
 
+    # The window counts ITEMS, so a producer pushing whole large-chunk
+    # bodies (32MB filer chunks) would hold window × chunk bytes queued
+    # ahead of a slow socket. Byte payloads larger than this are sliced
+    # at the put boundary so the real resident bound is window × 1MB;
+    # non-bytes items (sendfile ops) pass through whole.
+    MAX_PIECE = 1 << 20
+
     def __init__(self, loop: asyncio.AbstractEventLoop, window: int = 8):
         self._loop = loop
         self._window = max(1, window)
@@ -195,6 +202,14 @@ class ThreadFlume:
 
     # -- thread side --------------------------------------------------------
     def put(self, data: bytes, timeout: Optional[float] = None) -> None:
+        if isinstance(data, (bytes, bytearray)) and \
+                len(data) > self.MAX_PIECE:
+            for i in range(0, len(data), self.MAX_PIECE):
+                self._put_one(data[i:i + self.MAX_PIECE], timeout)
+            return
+        self._put_one(data, timeout)
+
+    def _put_one(self, data, timeout: Optional[float]) -> None:
         if not self._space.acquire(timeout=timeout):
             raise TimeoutError("flume backpressure timeout")
         with self._mu:
@@ -210,6 +225,35 @@ class ThreadFlume:
         with self._mu:
             self._closed = True
             self._wake_locked()
+
+    # -- loop-producer side --------------------------------------------------
+    def try_put(self, data) -> bool:
+        """Non-blocking put for LOOP-side producers (native-async
+        handlers share the connection's flume with bridged responses so
+        bytes stay ordered). False when the window is full."""
+        if not self._space.acquire(blocking=False):
+            return False
+        with self._mu:
+            if self._broken:
+                self._space.release()
+                raise ThreadFlumeClosed()
+            self._chunks.append(data)
+            self._wake_locked()
+        return True
+
+    async def aput(self, data) -> None:
+        """Awaitable put: polls the window without ever blocking the
+        loop. The poll only spins while a slow client holds the window
+        full — exactly when there is nothing better to do."""
+        if isinstance(data, (bytes, bytearray)) and \
+                len(data) > self.MAX_PIECE:
+            for i in range(0, len(data), self.MAX_PIECE):
+                piece = data[i:i + self.MAX_PIECE]
+                while not self.try_put(piece):
+                    await asyncio.sleep(0.005)
+            return
+        while not self.try_put(data):
+            await asyncio.sleep(0.005)
 
     def _wake_locked(self) -> None:
         w, self._waiter = self._waiter, None

@@ -1,0 +1,15 @@
+"""Fixture: a worker-thread hop inside a native-async handler —
+blocking-on-loop must fire exactly once, at the run_in_executor call.
+Native routes (``async def *_native``) exist to skip the thread bridge;
+awaiting the executor still schedules the thread, so the await does NOT
+exempt it (unlike the base blocking-on-loop walk)."""
+import asyncio
+
+
+def read_blocking(request):
+    return request
+
+
+async def _h_get_native(request):
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, read_blocking, request)

@@ -1,0 +1,401 @@
+"""Per-tenant QoS: token buckets, tenant classification, the
+weighted-fair governor, shed semantics on the wire (503 + Retry-After,
+keep-alive SURVIVES a shed), the idle-connection reaper, and the aio
+pooled transport the native filer→volume hop rides on.
+
+The governor is process-global (util/throttler.GOVERNOR), so every test
+that touches it resets it on the way in and out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.util.throttler import (
+    GOVERNOR,
+    INTERNAL_TENANT,
+    TenantGovernor,
+    TokenBucket,
+    classify_tenant,
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ----------------------------------------------------------- TokenBucket
+
+
+def test_bucket_burst_then_shed():
+    b = TokenBucket(rate=10.0, burst=3.0)
+    assert [b.reserve(1.0, 0.0) for _ in range(3)] == [0.0, 0.0, 0.0]
+    assert b.reserve(1.0, 0.0) is None  # burst spent, no wait allowed
+
+
+def test_bucket_pacing_delay_takes_debt():
+    b = TokenBucket(rate=10.0, burst=1.0)
+    assert b.reserve(1.0, 1.0) == 0.0
+    w1 = b.reserve(1.0, 1.0)
+    w2 = b.reserve(1.0, 1.0)
+    # both are admitted with a pacing delay, and the second queues BEHIND
+    # the first (debt), not on top of it
+    assert w1 and w2 and w2 > w1
+    assert b.reserve(1.0, 0.15) is None  # next would owe ~0.3s > cap
+
+
+def test_bucket_refills_to_burst_cap():
+    b = TokenBucket(rate=1000.0, burst=2.0)
+    b.reserve(2.0, 0.0)
+    time.sleep(0.05)  # 50 tokens earned, capped at burst=2
+    assert b.reserve(2.0, 0.0) == 0.0
+    assert b.reserve(1.0, 0.0) is None
+
+
+def test_bucket_set_rate_clamps_tokens():
+    b = TokenBucket(rate=1.0, burst=100.0)
+    b.set_rate(1.0, 2.0)
+    assert b.reserve(2.0, 0.0) == 0.0
+    assert b.reserve(1.0, 0.0) is None
+
+
+# ------------------------------------------------------ classify_tenant
+
+
+def _hget(d):
+    return lambda name, default="": d.get(name, default)
+
+
+@pytest.mark.parametrize("headers,addr,want", [
+    ({"X-Sweed-Internal": "1"}, "10.0.0.9", INTERNAL_TENANT),
+    ({"X-Sweed-Tenant": "acme"}, "10.0.0.9", "hdr:acme"),
+    ({"Authorization":
+      "AWS4-HMAC-SHA256 Credential=AKID/20260808/us/s3/aws4_request,"
+      " SignedHeaders=host, Signature=ab"}, "10.0.0.9", "ak:AKID"),
+    ({"Authorization": "AWS AKOLD:c2ln"}, "10.0.0.9", "ak:AKOLD"),
+    ({}, "203.0.113.77", "ip:203.0.113"),
+    ({}, "2001:db8:cafe::1", "ip:2001:db8:cafe"),
+])
+def test_classify_tenant(headers, addr, want):
+    assert classify_tenant(_hget(headers), addr) == want
+
+
+def test_classify_priority_internal_beats_everything():
+    h = {"X-Sweed-Internal": "1", "X-Sweed-Tenant": "acme",
+         "Authorization": "AWS AK:sig"}
+    assert classify_tenant(_hget(h), "1.2.3.4") == INTERNAL_TENANT
+
+
+# ------------------------------------------------------- TenantGovernor
+
+
+@pytest.fixture
+def governor(monkeypatch):
+    GOVERNOR.reset()
+    yield GOVERNOR
+    GOVERNOR.reset()
+
+
+def test_governor_disabled_admits_everything(governor, monkeypatch):
+    monkeypatch.delenv("SWEED_QOS_RPS", raising=False)
+    assert not governor.enabled()
+    assert governor.admit("hdr:anyone") == ("ok", 0.0)
+
+
+def test_governor_internal_always_bypasses(governor, monkeypatch):
+    monkeypatch.setenv("SWEED_QOS_RPS", "1")
+    monkeypatch.setenv("SWEED_QOS_MAX_DELAY_MS", "0")
+    for _ in range(50):
+        assert governor.admit(INTERNAL_TENANT) == ("ok", 0.0)
+
+
+def test_governor_sheds_past_burst_with_zero_delay(governor, monkeypatch):
+    monkeypatch.setenv("SWEED_QOS_RPS", "2")
+    monkeypatch.setenv("SWEED_QOS_MAX_DELAY_MS", "0")
+    outcomes = [governor.admit("hdr:greedy")[0] for _ in range(20)]
+    assert outcomes.count("ok") >= 2  # the one-second burst allowance
+    assert outcomes[-1] == "shed"
+    snap = governor.snapshot()
+    t = snap["tenants"]["hdr:greedy"]
+    assert t["shed"] > 0 and t["admitted"] >= 2
+    assert snap["shed_total"] == t["shed"]
+
+
+def test_governor_weighted_fair_shares(governor, monkeypatch):
+    monkeypatch.setenv("SWEED_QOS_RPS", "300")
+    monkeypatch.setenv("SWEED_QOS_WEIGHTS", "hdr:gold=2,*=1")
+    governor.admit("hdr:gold")
+    governor.admit("hdr:bronze")
+    governor.admit("hdr:gold")  # recompute sees both active
+    snap = governor.snapshot()["tenants"]
+    assert snap["hdr:gold"]["weight"] == 2.0
+    assert snap["hdr:gold"]["rate"] == pytest.approx(200.0)
+    assert snap["hdr:bronze"]["rate"] == pytest.approx(100.0)
+
+
+def test_governor_bounded_tenant_cardinality(governor, monkeypatch):
+    monkeypatch.setenv("SWEED_QOS_RPS", "1")
+    monkeypatch.setenv("SWEED_QOS_MAX_DELAY_MS", "0")
+    monkeypatch.setattr(TenantGovernor, "MAX_TENANTS", 4)
+    for i in range(16):
+        for _ in range(6):  # past burst → some sheds per tenant
+            governor.admit(f"ip:10.0.{i}")
+    snap = governor.snapshot()
+    assert len(snap["tenants"]) <= 4
+    # evicted tenants fold their shed counts into the total
+    assert snap["shed_total"] >= sum(
+        t["shed"] for t in snap["tenants"].values()
+    )
+
+
+# ------------------------------------------- shed semantics on the wire
+
+from seaweedfs_tpu.server.http_util import JsonHandler, start_server  # noqa: E402
+
+
+class _QApp(JsonHandler):
+    def log_message(self, fmt, *args):
+        pass
+
+
+def _q_routes():
+    def ping(h, path, q, body):
+        return 200, {"ok": True}
+
+    def hdr(h, path, q, body):
+        return 200, {"internal": h.headers.get("X-Sweed-Internal", "")}
+
+    def blob(h, path, q, body):
+        return 200, b"\xfeBLOB" * 300
+
+    return [("GET", "/ping", ping), ("GET", "/hdr", hdr),
+            ("GET", "/blob", blob)]
+
+
+_QApp.routes = _q_routes()
+
+
+def _raw_request(sock, path, extra=""):
+    sock.sendall(
+        f"GET {path} HTTP/1.1\r\nHost: t\r\n"
+        f"Content-Length: 0\r\n{extra}\r\n".encode()
+    )
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        got = sock.recv(65536)
+        if not got:
+            raise ConnectionError("EOF in headers")
+        buf += got
+    head, body = buf.split(b"\r\n\r\n", 1)
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split()[1])
+    hdrs = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        hdrs[k.strip().lower()] = v.strip()
+    want = int(hdrs.get("content-length", "0"))
+    while len(body) < want:
+        got = sock.recv(65536)
+        if not got:
+            break
+        body += got
+    return status, hdrs, body
+
+
+@pytest.mark.parametrize("mode", ["threads", "aio"])
+def test_shed_503_keeps_connection_alive(governor, monkeypatch, mode):
+    """A shed answers 503 + Retry-After on the SAME socket and keep-alive
+    survives: closing would turn every over-rate request into accept
+    churn that costs the server more than the abuser."""
+    monkeypatch.setenv("SWEED_MAX_INFLIGHT", "8192")
+    monkeypatch.setenv("SWEED_QOS_RPS", "1")
+    monkeypatch.setenv("SWEED_QOS_MAX_DELAY_MS", "0")
+    monkeypatch.setenv("SWEED_SERVING", mode)
+    srv = start_server(_QApp, "127.0.0.1", free_port())
+    host, port = srv.server_address[:2]
+    try:
+        c = socket.create_connection((host, port), timeout=10)
+        try:
+            statuses = []
+            for _ in range(12):
+                st, hdrs, _ = _raw_request(
+                    c, "/ping", extra="X-Sweed-Tenant: greedy\r\n"
+                )
+                statuses.append(st)
+                if st == 503:
+                    assert int(hdrs["retry-after"]) >= 1
+                    assert hdrs.get("connection") != "close"
+            assert 503 in statuses, statuses
+            assert statuses.count(200) >= 1
+            # the socket still serves: internal traffic bypasses the
+            # governor even while the tenant is saturated
+            st, _, body = _raw_request(
+                c, "/ping", extra="X-Sweed-Internal: 1\r\n"
+            )
+            assert st == 200 and b'"ok"' in body
+        finally:
+            c.close()
+        snap = GOVERNOR.snapshot()
+        assert snap["tenants"]["hdr:greedy"]["shed"] > 0
+    finally:
+        srv.server_close()
+
+
+def test_qos_metrics_quantiles_per_tenant(governor, monkeypatch):
+    """QoS is assertable from /metrics artifacts, not log-greps: the
+    per-tenant latency histogram and the decision counters move."""
+    from seaweedfs_tpu.stats.metrics import default_registry
+
+    monkeypatch.setenv("SWEED_MAX_INFLIGHT", "8192")
+    monkeypatch.setenv("SWEED_QOS_RPS", "1")
+    monkeypatch.setenv("SWEED_QOS_MAX_DELAY_MS", "0")
+    monkeypatch.setenv("SWEED_SERVING", "threads")
+    srv = start_server(_QApp, "127.0.0.1", free_port())
+    host, port = srv.server_address[:2]
+    try:
+        c = socket.create_connection((host, port), timeout=10)
+        try:
+            for _ in range(8):
+                _raw_request(c, "/ping", extra="X-Sweed-Tenant: m\r\n")
+        finally:
+            c.close()
+    finally:
+        srv.server_close()
+    text = default_registry.expose()
+    assert 'sweed_qos_request_seconds_bucket{' in text
+    assert 'tenant="hdr:m"' in text
+    assert 'sweed_qos_decisions_total{outcome="shed",tenant="hdr:m"}' in text
+
+
+# ------------------------------------------------------ idle reaper
+
+
+def test_idle_connection_reaped(monkeypatch):
+    from seaweedfs_tpu.stats import serving_stats
+
+    monkeypatch.setenv("SWEED_MAX_INFLIGHT", "8192")
+    monkeypatch.setenv("SWEED_SERVING", "aio")
+    monkeypatch.setenv("SWEED_IDLE_TIMEOUT", "1")
+    monkeypatch.setenv("SWEED_REAP_INTERVAL", "1")
+    srv = start_server(_QApp, "127.0.0.1", free_port())
+    host, port = srv.server_address[:2]
+    before = serving_stats()["reaped_idle"]
+    try:
+        c = socket.create_connection((host, port), timeout=10)
+        c.settimeout(8)
+        try:
+            # a working request first: the reaper must only take IDLE
+            # sockets, not the one that just replied
+            st, _, _ = _raw_request(c, "/ping")
+            assert st == 200
+            # now dribble nothing; the reaper severs us
+            assert c.recv(1) == b""
+        finally:
+            c.close()
+        assert serving_stats()["reaped_idle"] > before
+    finally:
+        srv.server_close()
+
+
+# ----------------------------------------------------- aio transport
+
+
+def test_aio_transport_request_and_internal_marking(monkeypatch):
+    from seaweedfs_tpu.server import aio_transport
+
+    monkeypatch.setenv("SWEED_MAX_INFLIGHT", "8192")
+    monkeypatch.setenv("SWEED_SERVING", "threads")
+    srv = start_server(_QApp, "127.0.0.1", free_port())
+    host, port = srv.server_address[:2]
+    try:
+        async def go():
+            st, body, hdrs = await aio_transport.request(
+                "GET", f"http://{host}:{port}/hdr"
+            )
+            # two sequential requests share the pooled socket
+            st2, blob, _ = await aio_transport.request(
+                "GET", f"http://{host}:{port}/blob"
+            )
+            pooled = aio_transport.pool_stats()
+            return st, body, st2, blob, pooled
+
+        st, body, st2, blob, pooled = asyncio.run(go())
+        assert st == 200
+        assert b'"internal": "1"' in body  # every hop is marked internal
+        assert st2 == 200 and blob == b"\xfeBLOB" * 300
+        assert any(
+            f"{host}:{port}" in per_loop and per_loop[f"{host}:{port}"] >= 1
+            for per_loop in pooled.values()
+        ), pooled
+    finally:
+        srv.server_close()
+
+
+def test_aio_transport_stream_reads_and_repools(monkeypatch):
+    from seaweedfs_tpu.server import aio_transport
+
+    monkeypatch.setenv("SWEED_MAX_INFLIGHT", "8192")
+    monkeypatch.setenv("SWEED_SERVING", "threads")
+    srv = start_server(_QApp, "127.0.0.1", free_port())
+    host, port = srv.server_address[:2]
+    want = b"\xfeBLOB" * 300
+    try:
+        async def go():
+            st, data, hdrs = await aio_transport.stream(
+                "GET", f"http://{host}:{port}/blob"
+            )
+            assert st == 200
+            assert data.length == len(want)
+            got = b""
+            while True:
+                piece = await data.read(256)
+                if not piece:
+                    break
+                got += piece
+            return got, aio_transport.pool_stats()
+
+        got, pooled = asyncio.run(go())
+        assert got == want
+        # fully-drained stream returns the socket to the pool
+        assert any(
+            per_loop.get(f"{host}:{port}", 0) >= 1
+            for per_loop in pooled.values()
+        ), pooled
+    finally:
+        srv.server_close()
+
+
+def test_aio_transport_idle_max_age_retires_sockets(monkeypatch):
+    """Satellite: pooled keep-alive sockets have an idle max-age in BOTH
+    pools — an _AConn past SWEED_POOL_IDLE_S reports stale and checkout
+    discards it instead of racing the peer's close."""
+    from seaweedfs_tpu.server.aio_transport import _AConn
+
+    class _R:
+        def at_eof(self):
+            return False
+
+    class _W:
+        def is_closing(self):
+            return False
+
+        def close(self):
+            pass
+
+    conn = _AConn(_R(), _W())
+    monkeypatch.setenv("SWEED_POOL_IDLE_S", "1")
+    assert not conn.stale()
+    conn.idle_since -= 1.5
+    assert conn.stale()
+    monkeypatch.setenv("SWEED_POOL_IDLE_S", "0")  # 0 disables reaping
+    assert not conn.stale()

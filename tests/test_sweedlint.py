@@ -49,6 +49,8 @@ CASES = [
     # PR 14 lifecycle autopilot: maintenance loops must yield to traffic
     ("maintenance-without-interlock", "maintenance_without_interlock",
      "cluster/fixture.py"),
+    # native-async handlers must not re-add the worker-thread bridge
+    ("blocking-on-loop", "native_bridge", "server/fixture.py"),
 ]
 
 
