@@ -61,6 +61,7 @@ __all__ = [
     "analyze_paths",
     "baseline_diff",
     "load_baseline",
+    "waiver_inventory",
 ]
 
 _SUPPRESS_RE = re.compile(
@@ -110,8 +111,8 @@ def _audit_waivers(
     Two rounds so that stale-waiver findings are themselves waivable:
     round one audits waivers naming ordinary rules; round two audits
     waivers naming ``stale-waiver`` against round one's output (a
-    ``# sweedlint: ok stale-waiver ...`` comment with nothing stale
-    beneath it is itself rot).
+    comment waiving ``stale-waiver`` with nothing stale beneath it is
+    itself rot).
     """
     comments: list[tuple[str, int, str]] = []
     for rel, _tree, src_lines in parsed:
@@ -151,14 +152,11 @@ def _audit_waivers(
     return out
 
 
-def _analyze(
-    file_entries: list[tuple[str, str]], audit_waivers: bool
-) -> list[Violation]:
-    """Shared engine: per-file rules on each module, then the
-    interprocedural rules over the project they jointly form, then the
-    waiver audit, then suppression filtering — in that order, because a
-    waiver must be able to silence an interprocedural finding and the
-    audit must see pre-suppression results."""
+def _scan(
+    file_entries: list[tuple[str, str]]
+) -> tuple[list[tuple[str, ast.AST, list[str]]], list[Violation]]:
+    """Parse + run every rule, pre-audit and pre-suppression: the raw
+    finding set a waiver's liveness is judged against."""
     from . import rules as _rules
     from .callgraph import Project
 
@@ -185,10 +183,25 @@ def _analyze(
 
     if parsed:
         from . import lockgraph as _lockgraph
+        from . import racecheck as _racecheck
         from . import taint as _taint
 
-        found.extend(_lockgraph.check_project(project))
+        builder = _lockgraph.LockGraphBuilder(project)
+        found.extend(builder.violations())
         found.extend(_taint.check_project(project))
+        found.extend(_racecheck.check_project(project, builder))
+    return parsed, found
+
+
+def _analyze(
+    file_entries: list[tuple[str, str]], audit_waivers: bool
+) -> list[Violation]:
+    """Shared engine: per-file rules on each module, then the
+    interprocedural rules over the project they jointly form, then the
+    waiver audit, then suppression filtering — in that order, because a
+    waiver must be able to silence an interprocedural finding and the
+    audit must see pre-suppression results."""
+    parsed, found = _scan(file_entries)
 
     if audit_waivers:
         fired = {(v.path, v.rule, v.line) for v in found}
@@ -239,6 +252,48 @@ def analyze_paths(
         for full, rel in _iter_py_files(root):
             entries.append((full, rel.replace(os.sep, "/")))
     return _analyze(entries, audit_waivers)
+
+
+def waiver_inventory(paths: Iterable[str]) -> list[dict]:
+    """Every ``sweedlint: ok`` comment under ``paths`` with its audited
+    liveness — the ``--waivers`` CLI mode.  Each entry is ``{"path",
+    "line", "rule", "reason", "status"}`` where status is ``"LIVE"``
+    (the named rule still fires on a covered line: the waiver earns its
+    keep) or ``"STALE"`` (the code was fixed or the comment drifted;
+    delete it).  Liveness is the same two-round judgment the gate's
+    stale-waiver rule applies, so ``--waivers`` never disagrees with
+    the gate about which comments are dead."""
+    entries: list[tuple[str, str]] = []
+    for root in paths:
+        for full, rel in _iter_py_files(root):
+            entries.append((full, rel.replace(os.sep, "/")))
+    parsed, found = _scan(entries)
+    fired = {(v.path, v.rule, v.line) for v in found}
+    # the gate filters audit findings through the suppression map too
+    # (a waiver naming stale-waiver can cover a dead waiver below it),
+    # so liveness here must apply the same filter or the two disagree
+    waived = {rel: _suppressed_lines(sl) for rel, _t, sl in parsed}
+    stale_at = {
+        (v.path, v.line)
+        for v in _audit_waivers(parsed, fired)
+        if v.rule not in waived.get(v.path, {}).get(v.line, ())
+    }
+    out: list[dict] = []
+    for rel, _tree, src_lines in parsed:
+        for i, text in enumerate(src_lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            out.append(
+                {
+                    "path": rel,
+                    "line": i,
+                    "rule": m.group("rule"),
+                    "reason": m.group("reason").strip(),
+                    "status": "STALE" if (rel, i) in stale_at else "LIVE",
+                }
+            )
+    return sorted(out, key=lambda w: (w["path"], w["line"], w["rule"]))
 
 
 # -- baseline -----------------------------------------------------------------

@@ -12,7 +12,13 @@ import os
 import subprocess
 import sys
 
-from . import _analyze, analyze_paths, baseline_diff, load_baseline
+from . import (
+    _analyze,
+    analyze_paths,
+    baseline_diff,
+    load_baseline,
+    waiver_inventory,
+)
 
 _SARIF_VERSION = "2.1.0"
 _SARIF_SCHEMA = (
@@ -119,6 +125,19 @@ def main(argv=None) -> int:
         help="SARIF 2.1.0 output (code-scanning upload format)",
     )
     p.add_argument(
+        "--sarif-out",
+        metavar="FILE",
+        help="also write the SARIF 2.1.0 document to FILE (the CI "
+        "artifact path), independent of the stdout format",
+    )
+    p.add_argument(
+        "--waivers",
+        action="store_true",
+        help="audit mode: list every 'sweedlint: ok' comment with its "
+        "liveness (LIVE = the named rule still fires on a covered "
+        "line, STALE = delete it); exit 1 if anything is stale",
+    )
+    p.add_argument(
         "--keys",
         action="store_true",
         help="print violation keys only (paste into a baseline file)",
@@ -136,6 +155,29 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.changed and args.paths:
         p.error("--changed and explicit paths are mutually exclusive")
+    if args.waivers and args.changed:
+        p.error(
+            "--waivers needs the whole project: on a partial file set "
+            "the interprocedural rules cannot fire, so every waiver "
+            "they justify would misreport as stale"
+        )
+
+    if args.waivers:
+        paths = args.paths or [os.path.dirname(os.path.dirname(__file__))]
+        inv = waiver_inventory(paths)
+        if args.json:
+            print(json.dumps({"waivers": inv}, indent=1))
+        else:
+            for w in inv:
+                print(
+                    f"{w['status']:5} [{w['rule']}] "
+                    f"{w['path']}:{w['line']}  {w['reason']}"
+                )
+            stale_n = sum(1 for w in inv if w["status"] == "STALE")
+            print(
+                f"sweedlint: {len(inv)} waiver(s), {stale_n} stale"
+            )
+        return 1 if any(w["status"] == "STALE" for w in inv) else 0
 
     if args.changed:
         pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -151,6 +193,15 @@ def main(argv=None) -> int:
         violations = analyze_paths(paths)
     baseline = load_baseline(args.baseline) if args.baseline else []
     new, stale = baseline_diff(violations, baseline)
+
+    if args.sarif_out:
+        doc = _to_sarif(new)
+        out_dir = os.path.dirname(os.path.abspath(args.sarif_out))
+        os.makedirs(out_dir, exist_ok=True)
+        tmp = args.sarif_out + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.sarif_out)
 
     if args.sarif:
         print(json.dumps(_to_sarif(new), indent=1))

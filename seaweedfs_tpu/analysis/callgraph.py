@@ -364,7 +364,8 @@ class Project:
                 return TypeRef(elem=inner.cls)
             if base_name in ("list", "List", "set", "Set", "frozenset",
                              "deque", "Iterable", "Iterator", "Sequence",
-                             "tuple", "Tuple") and args:
+                             "tuple", "Tuple", "AsyncIterable",
+                             "AsyncIterator", "AsyncGenerator") and args:
                 inner = self.type_from_annotation(args[0], mi)
                 return TypeRef(elem=inner.cls)
             return _NOTHING
@@ -400,6 +401,36 @@ class Project:
                         and tgt.value.id == "self"
                     ):
                         ci.attr_types.setdefault(tgt.attr, TypeRef(cls=cls))
+        # `self._flume = flume` where flume is an annotated parameter:
+        # the attribute inherits the parameter's declared type
+        for m in ci.methods.values():
+            args = m.node.args
+            ann_by_name: dict[str, TypeRef] = {}
+            for a in (
+                list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+            ):
+                if a.annotation is not None:
+                    t = self.type_from_annotation(a.annotation, mi)
+                    if not t.empty:
+                        ann_by_name[a.arg] = t
+            if not ann_by_name:
+                continue
+            for node in ast.walk(m.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                ):
+                    continue
+                t = ann_by_name.get(node.value.id)
+                if t is None:
+                    continue
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        ci.attr_types.setdefault(tgt.attr, t)
 
 
 class CallGraph:
@@ -460,6 +491,10 @@ class CallGraph:
         self, expr: ast.expr, fi: FuncInfo, env: dict[str, TypeRef]
     ) -> TypeRef:
         p = self.project
+        if isinstance(expr, ast.Await):
+            # `x = await self._afetch()` types as the coroutine's return
+            # annotation — the await wrapper is transparent to the value
+            return self.expr_type(expr.value, fi, env)
         if isinstance(expr, ast.Name):
             return env.get(expr.id, _NOTHING)
         if isinstance(expr, ast.Attribute):

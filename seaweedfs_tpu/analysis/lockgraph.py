@@ -40,7 +40,13 @@ from . import Violation
 from .callgraph import CallGraph, ClassInfo, FuncInfo, Project
 
 #: factory name → lock kind; the ``make_*`` forms are the runtime
-#: sanitizer wrappers in ``util/locks.py``.
+#: sanitizer wrappers in ``util/locks.py``.  ``asyncio.Lock`` resolves
+#: to the *async* kinds below instead (same factory names, different
+#: module): an asyncio lock participates in lock-order cycle detection
+#: like any other node, but it only excludes coroutines on ONE loop —
+#: it is no protection against a worker/background thread, which is why
+#: the cross-domain race rule (``racecheck.py``) ignores async kinds
+#: when intersecting locksets.
 LOCK_FACTORIES = {
     "Lock": "lock",
     "RLock": "rlock",
@@ -48,6 +54,14 @@ LOCK_FACTORIES = {
     "make_rlock": "rlock",
     "OrderedLock": "lock",
 }
+#: asyncio.* equivalents — kind "alock"/"acond"
+ASYNC_LOCK_KINDS = {
+    "Lock": "alock",
+    "Condition": "acond",
+}
+#: lock kinds that provide mutual exclusion across OS threads (the only
+#: kinds a cross-domain lockset intersection may count)
+THREAD_LOCK_KINDS = frozenset({"lock", "rlock"})
 _CONDITION_FACTORIES = ("Condition", "make_condition")
 
 _SCOPES = ("cluster/", "server/", "storage/", "messaging/")
@@ -225,8 +239,26 @@ class LockGraphBuilder:
         self._build_loop_rule()
 
     # -- lock declarations ----------------------------------------------------
+    def _is_asyncio_factory(self, call: ast.Call, mi) -> bool:
+        """True when the factory call resolves into the asyncio module
+        (``asyncio.Lock()``, ``aio.Condition()`` after ``import asyncio
+        as aio``, ``Lock()`` after ``from asyncio import Lock``)."""
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            mod = self.project._expr_module(f.value, mi)
+            return mod is not None and mod.split(".")[0] == "asyncio"
+        if isinstance(f, ast.Name):
+            kind_target = mi.symbols.get(f.id)
+            return bool(
+                kind_target
+                and kind_target[0] == "symbol"
+                and kind_target[1].startswith("asyncio.")
+            )
+        return False
+
     def _collect_decls(self) -> None:
         for ci in self.project.classes.values():
+            mi = self.project.modules[ci.modname]
             pending_conditions: list[tuple[str, ast.Call, int]] = []
             for node in ast.walk(ci.node):
                 if not isinstance(node, ast.Assign):
@@ -241,6 +273,10 @@ class LockGraphBuilder:
                     if isinstance(call.func, ast.Name)
                     else ""
                 )
+                is_async = (
+                    fname in ASYNC_LOCK_KINDS
+                    and self._is_asyncio_factory(call, mi)
+                )
                 for tgt in node.targets:
                     if not (
                         isinstance(tgt, ast.Attribute)
@@ -248,7 +284,17 @@ class LockGraphBuilder:
                         and tgt.value.id == "self"
                     ):
                         continue
-                    if fname in LOCK_FACTORIES:
+                    if is_async:
+                        # asyncio primitives carry no name argument; the
+                        # node id is always Class.attr
+                        node_id = f"{ci.name}.{tgt.attr}"
+                        decl = LockDecl(
+                            node_id, ci.qualname, tgt.attr,
+                            ASYNC_LOCK_KINDS[fname], ci.relpath, node.lineno,
+                        )
+                        self.graph.decls.setdefault(node_id, decl)
+                        self._decl_by_attr[(ci.qualname, tgt.attr)] = node_id
+                    elif fname in LOCK_FACTORIES:
                         node_id = self._literal_name(call) or f"{ci.name}.{tgt.attr}"
                         decl = LockDecl(
                             node_id, ci.qualname, tgt.attr,

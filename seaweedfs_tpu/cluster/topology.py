@@ -16,6 +16,7 @@ from typing import Callable, Optional
 from ..storage.replica_placement import ReplicaPlacement
 from ..storage.ttl import TTL
 from ..util.locks import make_rlock
+from ..util.racecheck import instrument
 
 
 @dataclass
@@ -74,7 +75,6 @@ class Node:
         self.id = node_id
         self.children: dict[str, "Node"] = {}
         self.parent: Optional["Node"] = None
-        self._volume_count = 0
         self._max_volume_count = 0
 
     # capacity aggregates are recomputed on demand (simpler than the
@@ -86,7 +86,7 @@ class Node:
 
     def volume_count(self) -> int:
         if not self.children:
-            return self._volume_count
+            return 0  # a leaf Rack/DC holds nothing; DataNode overrides
         return sum(c.volume_count() for c in self.children.values())
 
     def free_space(self) -> int:
@@ -182,8 +182,11 @@ class DataNode(Node):
     def grpc_url(self) -> str:
         return f"{self.ip}:{self.port + 10000}"
 
-    def adjust_counts(self) -> None:
-        self._volume_count = len(self.volumes)
+    def volume_count(self) -> int:
+        # derived from the volumes dict on demand: a cached count would
+        # be one more field every sync/growth path must keep coherent
+        # across the handler and background domains
+        return len(self.volumes)
 
     def get_rack(self) -> "Rack":
         return self.parent  # type: ignore[return-value]
@@ -210,6 +213,7 @@ class DataCenter(Node):
         return r
 
 
+@instrument
 class Topology(Node):
     def __init__(self, volume_size_limit: int = 30 * 1024 * 1024 * 1024):
         super().__init__("topo")
@@ -279,7 +283,6 @@ class Topology(Node):
                 if vid not in incoming:
                     deleted_vis.append(vi)
             dn.volumes = incoming
-            dn.adjust_counts()
             for vi in new_vis:
                 self._register_volume(vi, dn)
             for vi in deleted_vis:
@@ -305,7 +308,6 @@ class Topology(Node):
                 vi = VolumeInfo.from_heartbeat(m)
                 dn.volumes.pop(vi.id, None)
                 self._unregister_volume(vi, dn)
-            dn.adjust_counts()
 
     def _register_volume(self, vi: VolumeInfo, dn: DataNode) -> None:
         layout = self.get_volume_layout(vi.collection, vi.replica_placement, vi.ttl)
@@ -332,7 +334,6 @@ class Topology(Node):
             dn.ec_shards = {}
             dn.ec_read_heat = {}
             dn.ec_corrupt = {}
-            dn.adjust_counts()
             if dn.parent:
                 dn.parent.children.pop(dn.id, None)
             return affected

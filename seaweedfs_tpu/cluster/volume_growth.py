@@ -139,9 +139,13 @@ class VolumeGrowth:
                 ttl=option.ttl,
                 version=3,
             )
-            server.volumes[vid] = vi
-            server.adjust_counts()
-            topo._register_volume(vi, server)
+            # the heartbeat sync paths mutate server.volumes and the
+            # layouts under topo._lock (an RLock) from the background
+            # domain; growth runs on a handler thread, so it must take
+            # the same lock or a full sync can interleave mid-register
+            with topo._lock:
+                server.volumes[vid] = vi
+                topo._register_volume(vi, server)
             if self.on_register is not None:
                 self.on_register(vid, server)
 

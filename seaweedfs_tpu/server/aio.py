@@ -50,6 +50,7 @@ from typing import Optional
 
 from seaweedfs_tpu.util import faultpoints, glog
 from seaweedfs_tpu.util.aio_pipeline import ThreadFlume, ThreadFlumeClosed
+from seaweedfs_tpu.util.racecheck import instrument
 from seaweedfs_tpu.util.throttler import GOVERNOR
 
 from ..stats import trace as _trace
@@ -249,6 +250,7 @@ class _ShimConn:
         self._flume = flume
 
     def settimeout(self, t) -> None:
+        # sweedlint: ok cross-domain-race per-connection shim; only the one worker serving this connection writes it
         self._rfile.timeout = t
 
     def gettimeout(self):
@@ -258,9 +260,12 @@ class _ShimConn:
         op = _SendfileOp(file, offset, count)
         try:
             self._flume.put(op)
+            # wait() raises ThreadFlumeClosed too when close_read
+            # rejects the op after it was queued but before the pump
+            # reached it
+            return op.wait()
         except ThreadFlumeClosed:
             raise BrokenPipeError("client connection gone") from None
-        return op.wait()
 
 
 # -- native-async fast path ---------------------------------------------------
@@ -398,6 +403,7 @@ def _run_request(handler_cls, server, conn, rfile, wfile,
     return bool(h.close_connection)
 
 
+@instrument
 class AioHTTPServer:
     """Event-loop serving core with the socketserver lifecycle surface.
 
@@ -480,6 +486,7 @@ class AioHTTPServer:
             loop.run_until_complete(self._main())
         except Exception as e:
             if not self._ready.is_set():
+                # sweedlint: ok cross-domain-race startup handshake: the write happens-before _ready.set(); readers wait on _ready
                 self._startup_error = e
                 self._ready.set()
             else:
@@ -811,6 +818,7 @@ class AioHTTPServer:
             if span is not None:
                 span.tags["status"] = status
                 if status >= 500:
+                    # sweedlint: ok cross-domain-race per-request span; created and finished on the one task/thread serving the request
                     span.status = "error"
                 extra.setdefault(_trace.TRACE_ID_HEADER, span.trace_id)
             close = (

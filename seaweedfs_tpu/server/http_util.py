@@ -15,6 +15,8 @@ import urllib.parse
 import urllib.request
 
 from seaweedfs_tpu.util import glog
+from seaweedfs_tpu.util.locks import make_lock
+from seaweedfs_tpu.util.racecheck import instrument
 from seaweedfs_tpu.util.throttler import (
     GOVERNOR,
     INTERNAL_HEADER,
@@ -134,6 +136,7 @@ def admission_reject_response() -> bytes:
     ).encode("ascii")
 
 
+@instrument
 class _ServingState:
     """Cross-server serving-core counters backing the ``sweed_serving_*``
     gauges and the /_status "serving" section. Live servers (threads or
@@ -141,7 +144,7 @@ class _ServingState:
     can never drift from the per-server truth."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("_ServingState._lock")
         self._servers: "weakref.WeakSet" = weakref.WeakSet()
         self._rejected = 0
         self._keepalive_shed = 0

@@ -247,6 +247,7 @@ class VolumeServer:
                     # keeps the pure zero-copy path
                     f, data_off, data_len = ext
                     try:
+                        # sweedlint: ok cross-domain-race per-request Needle; one request path builds it, never shared across domains
                         n.data = os.pread(f.fileno(), data_len, data_off)
                     finally:
                         f.close()
@@ -591,19 +592,23 @@ class VolumeServer:
 
             n.set_flag(FLAG_IS_CHUNK_MANIFEST)
         if name:
+            # sweedlint: ok cross-domain-race per-request Needle; one request path builds it, never shared across domains
             n.name = name.encode()[:255]
             n.set_flag(FLAG_HAS_NAME)
         if mime:
+            # sweedlint: ok cross-domain-race per-request Needle; one request path builds it, never shared across domains
             n.mime = mime.encode()[:255]
             n.set_flag(FLAG_HAS_MIME)
         import time as _time
 
+        # sweedlint: ok cross-domain-race per-request Needle; one request path builds it, never shared across domains
         n.last_modified = int(_time.time())
         n.set_flag(FLAG_HAS_LAST_MODIFIED)
         if q.get("ttl"):
             from ..storage.needle import FLAG_HAS_TTL
             from ..storage.ttl import read_ttl
 
+            # sweedlint: ok cross-domain-race per-request Needle; one request path builds it, never shared across domains
             n.ttl = read_ttl(q["ttl"])
             n.set_flag(FLAG_HAS_TTL)
         _, size, unchanged = self.store.write_volume_needle(

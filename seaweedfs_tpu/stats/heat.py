@@ -21,6 +21,7 @@ import time
 import weakref
 
 from ..util.locks import make_lock
+from ..util.racecheck import instrument
 from ..util.parsers import tolerant_ufloat
 
 # one half-life of inactivity halves a volume's heat: long enough that a
@@ -33,6 +34,7 @@ HEAT_HALFLIFE_SECONDS = tolerant_ufloat(
 ) or 60.0
 
 
+@instrument
 class EwmaHeat:
     """Exponentially-decayed op counter.
 

@@ -26,6 +26,9 @@ import threading
 import time
 from typing import Optional
 
+from ..util.locks import make_lock
+from ..util.racecheck import instrument
+
 _DEFAULT_BUCKETS = (
     0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
@@ -52,6 +55,7 @@ def _fmt_labels(labels: dict) -> str:
     return "{" + inner + "}"
 
 
+@instrument
 class Histogram:
     """Prometheus-shaped histogram with exemplars and quantile estimates.
 
@@ -68,7 +72,7 @@ class Histogram:
         # per label set, per bucket: last (trace_id, value) that landed in
         # that bucket (None until one does); index len(buckets) is +Inf
         self._exemplars: dict[tuple, list] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("Histogram._lock")
 
     def observe(self, value: float, trace_id: Optional[str] = None,
                 **labels) -> None:

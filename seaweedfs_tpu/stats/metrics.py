@@ -13,6 +13,8 @@ import threading
 from typing import Optional
 
 from ..util import glog
+from ..util.locks import make_lock
+from ..util.racecheck import instrument
 from .histogram import (  # noqa: F401  (re-exported: stats API surface)
     _DEFAULT_BUCKETS,
     Histogram,
@@ -21,11 +23,12 @@ from .histogram import (  # noqa: F401  (re-exported: stats API surface)
 )
 
 
+@instrument
 class Counter:
     def __init__(self, name: str, help_: str = ""):
         self.name, self.help = name, help_
         self._values: dict[tuple, float] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("Counter._lock")
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         key = tuple(sorted(labels.items()))
@@ -48,12 +51,13 @@ class Counter:
         return out
 
 
+@instrument
 class Gauge:
     def __init__(self, name: str, help_: str = ""):
         self.name, self.help = name, help_
         self._values: dict[tuple, float] = {}
         self._fns: dict[tuple, callable] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("Gauge._lock")
 
     def set(self, value: float, **labels) -> None:
         with self._lock:
@@ -88,7 +92,7 @@ class Gauge:
 class Registry:
     def __init__(self):
         self._metrics: dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("Registry._lock")
 
     def counter(self, name: str, help_: str = "") -> Counter:
         return self._get_or_make(name, lambda: Counter(name, help_))

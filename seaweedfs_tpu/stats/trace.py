@@ -39,6 +39,8 @@ from collections import deque
 from typing import Optional
 
 from ..util import glog
+from ..util.locks import make_lock
+from ..util.racecheck import instrument
 
 TRACE_HEADER = "X-Sweed-Trace"
 TRACE_ID_HEADER = "X-Sweed-Trace-Id"  # response: tells the client its trace
@@ -172,6 +174,7 @@ def parse_header(value: Optional[str]) -> tuple[str, str]:
     return trace_id, parent
 
 
+@instrument
 class TraceRing:
     """Process-wide bounded ring of finished spans.
 
@@ -185,7 +188,7 @@ class TraceRing:
     a lock + deque append."""
 
     def __init__(self, capacity: Optional[int] = None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("TraceRing._lock")
         self._capacity = capacity or ring_capacity()
         self._spans: deque = deque(maxlen=self._capacity)
         self._added = 0

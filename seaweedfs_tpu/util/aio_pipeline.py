@@ -31,6 +31,9 @@ import threading
 from collections import deque
 from typing import AsyncIterable, Callable, Iterable, Optional, Union
 
+from .locks import make_lock
+from .racecheck import instrument
+
 
 async def _aiter(items: Union[Iterable, AsyncIterable]):
     """Uniform async view over a sync or async iterable."""
@@ -169,6 +172,7 @@ class ThreadFlumeClosed(Exception):
     producer writes raise this so handler threads stop generating."""
 
 
+@instrument
 class ThreadFlume:
     """Bounded thread→loop byte channel.
 
@@ -193,7 +197,7 @@ class ThreadFlume:
     def __init__(self, loop: asyncio.AbstractEventLoop, window: int = 8):
         self._loop = loop
         self._window = max(1, window)
-        self._mu = threading.Lock()
+        self._mu = make_lock("ThreadFlume._mu")
         self._chunks: deque = deque()
         self._space = threading.Semaphore(self._window)
         self._closed = False  # producer finished
@@ -286,11 +290,19 @@ class ThreadFlume:
         return data
 
     def close_read(self) -> None:
-        """Consumer gone: drop queued chunks and poison future puts."""
+        """Consumer gone: drop queued chunks and poison future puts.
+
+        Dropped entries that carry a waiter (a queued ``_SendfileOp``
+        whose producer thread is parked in ``op.wait()``) are rejected,
+        not just discarded — silently dropping one leaves that worker
+        blocked forever on an event nobody will ever set."""
         with self._mu:
             self._broken = True
-            n = len(self._chunks)
+            dropped = list(self._chunks)
             self._chunks.clear()
             self._wake_locked()
-        for _ in range(n):
+        for item in dropped:
             self._space.release()
+            reject = getattr(item, "reject", None)
+            if reject is not None:
+                reject(ThreadFlumeClosed())

@@ -30,6 +30,9 @@ import threading
 import time
 from typing import Optional, TextIO
 
+from .locks import make_lock
+from .racecheck import instrument
+
 INFO, WARNING, ERROR, FATAL = 0, 1, 2, 3
 _SEV_CHAR = "IWEF"
 _SEV_NAME = {"INFO": INFO, "WARNING": WARNING, "ERROR": ERROR, "FATAL": FATAL}
@@ -39,6 +42,7 @@ _SEV_NAME = {"INFO": INFO, "WARNING": WARNING, "ERROR": ERROR, "FATAL": FATAL}
 MAX_BYTES = 256 * 1024 * 1024
 
 
+@instrument
 class _State:
     def __init__(self) -> None:
         self.verbosity = 0
@@ -49,7 +53,7 @@ class _State:
         self.log_dir: Optional[str] = None
         self._file: Optional[TextIO] = None
         self._file_bytes = 0
-        self.lock = threading.Lock()
+        self.lock = make_lock("_State.lock")
 
     def effective_level(self, module: str) -> int:
         lvl = self._vcache.get(module)
@@ -65,7 +69,9 @@ class _State:
     def reset_cache(self) -> None:
         self._vcache.clear()
 
-    def out_file(self) -> Optional[TextIO]:
+    def out_file_locked(self) -> Optional[TextIO]:
+        # `_locked` convention: the only caller is _emit, which already
+        # holds self.lock around rotation and the write that follows.
         if self.log_dir is None:
             return None
         if self._file is None or self._file_bytes > MAX_BYTES:
@@ -100,7 +106,7 @@ def _emit(sev: int, module: str, lineno: int, fmt: str, args: tuple) -> None:
         f"{threading.get_ident() % 100000:5d} {module}:{lineno}] {msg}\n"
     )
     with _state.lock:
-        f = _state.out_file()
+        f = _state.out_file_locked()
         if f is not None:
             f.write(line)
             _state._file_bytes += len(line)

@@ -27,10 +27,12 @@ from collections import OrderedDict
 from typing import Optional
 
 from .locks import make_lock
+from .racecheck import instrument
 
 DEFAULT_SHARDS = 16
 
 
+@instrument
 class _Shard:
     """One LRU shard: key -> (cookie, payload), most-recent last."""
 
@@ -90,12 +92,14 @@ class _Shard:
                     self._bytes, len(self._entries))
 
 
+@instrument
 class NeedleCache:
     """Sharded LRU over needle payloads, keyed ``(vid, needle_id)``."""
 
     def __init__(self, capacity_bytes: int = 0, shards: int = DEFAULT_SHARDS):
         self._shards = [_Shard() for _ in range(shards)]
         self._capacity = 0
+        self._resize_mu = make_lock("NeedleCache._resize_mu")
         self.set_capacity(capacity_bytes)
         _caches.add(self)
 
@@ -109,12 +113,18 @@ class NeedleCache:
 
     def set_capacity(self, capacity_bytes: int) -> None:
         """Resize the total byte budget (0 disables); evicts immediately
-        so a shrink takes effect without waiting for traffic."""
+        so a shrink takes effect without waiting for traffic.
+
+        Serialized: an admin resize (handler thread) racing an autopilot
+        resize (background thread) would otherwise interleave the
+        per-shard loop and leave shard budgets mixed between the two
+        totals — and ``_capacity`` agreeing with neither."""
         capacity_bytes = max(0, int(capacity_bytes))
-        self._capacity = capacity_bytes
-        per_shard = capacity_bytes // len(self._shards)
-        for s in self._shards:
-            s.resize(per_shard)
+        with self._resize_mu:
+            self._capacity = capacity_bytes
+            per_shard = capacity_bytes // len(self._shards)
+            for s in self._shards:
+                s.resize(per_shard)
 
     def would_cache(self, size: int) -> bool:
         """True when an entry of ``size`` bytes fits the per-shard budget —

@@ -35,6 +35,9 @@ import os
 import threading
 import time
 
+from .locks import make_lock
+from .racecheck import instrument
+
 
 class WriteThrottler:
     def __init__(self, bytes_per_second: int = 0):
@@ -104,6 +107,7 @@ def classify_tenant(header_get, remote_addr: str) -> str:
     return "ip:" + ".".join(remote_addr.split(".")[:3])
 
 
+@instrument
 class TokenBucket:
     """Monotonic-clock token bucket; thread-safe (shared by the threads
     core's workers and the aio loop).
@@ -114,7 +118,7 @@ class TokenBucket:
     when the wait would exceed ``max_wait`` — the caller sheds."""
 
     def __init__(self, rate: float, burst: float):
-        self._mu = threading.Lock()
+        self._mu = make_lock("TokenBucket._mu")
         self.rate = max(rate, 1e-3)
         self.burst = max(burst, 1.0)
         self._tokens = self.burst
@@ -143,6 +147,7 @@ class TokenBucket:
             return None
 
 
+@instrument
 class _Tenant:
     __slots__ = ("bucket", "weight", "last_seen",
                  "admitted", "delayed", "shed")
@@ -156,6 +161,7 @@ class _Tenant:
         self.shed = 0
 
 
+@instrument
 class TenantGovernor:
     """Weighted-fair request admission across tenants.
 
@@ -173,7 +179,7 @@ class TenantGovernor:
     MAX_TENANTS = 1024
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = make_lock("TenantGovernor._mu")
         self._tenants: dict[str, _Tenant] = {}
         self._next_recompute = 0.0
         self._evicted_shed = 0

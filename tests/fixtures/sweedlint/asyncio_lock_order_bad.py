@@ -1,0 +1,27 @@
+"""ABBA inversion spanning BOTH lock kinds: an asyncio.Lock and a
+threading lock acquired in opposite orders.  The asyncio kind is a
+first-class node in the acquisition-order graph, so the cycle is
+detected even though one edge lives on the loop and the other in a
+sync region.  (The threading-lock-held-at-await hazard inside
+``rebalance`` is real too, but it is this fixture's *other* rule — it
+is waived here so the lock-order cycle is the single finding.)"""
+import asyncio
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = asyncio.Lock()
+        self._mu = threading.Lock()
+        self._n = 0
+
+    async def transfer(self):
+        async with self._alock:
+            with self._mu:
+                self._n += 1
+
+    async def rebalance(self):
+        with self._mu:
+            # sweedlint: ok lock-held-across-await fixture isolates the lock-order cycle; the await-under-lock hazard has its own fixture
+            async with self._alock:
+                self._n -= 1

@@ -1,0 +1,22 @@
+"""Waived flavor of the asyncio/threading ABBA fixture."""
+import asyncio
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = asyncio.Lock()
+        self._mu = threading.Lock()
+        self._n = 0
+
+    async def transfer(self):
+        async with self._alock:
+            # sweedlint: ok lock-order startup-only path; rebalance never runs concurrently with transfer by construction
+            with self._mu:
+                self._n += 1
+
+    async def rebalance(self):
+        with self._mu:
+            # sweedlint: ok lock-held-across-await fixture isolates the lock-order cycle; the await-under-lock hazard has its own fixture
+            async with self._alock:
+                self._n -= 1

@@ -1,0 +1,18 @@
+"""A decorated coroutine is still an ``async def`` scope: the decorator
+must not hide the loop-blocking call inside it."""
+import functools
+import time
+
+
+def logged(fn):
+    @functools.wraps(fn)
+    def wrap(*a, **k):
+        return fn(*a, **k)
+
+    return wrap
+
+
+class Store:
+    @logged
+    async def handle(self):
+        time.sleep(0.1)

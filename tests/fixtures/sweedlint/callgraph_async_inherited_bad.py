@@ -1,0 +1,19 @@
+"""An inherited coroutine resolves through the MRO and its awaited
+return value types the receiver in the subclass."""
+import time
+
+
+class Extent:
+    def slow_read(self):
+        time.sleep(0.1)
+
+
+class Base:
+    async def _afetch(self) -> Extent:
+        return Extent()
+
+
+class Child(Base):
+    async def handle(self):
+        extent = await self._afetch()
+        extent.slow_read()

@@ -1,0 +1,19 @@
+"""Awaited-call values must type-resolve: the blocking call is only
+reachable through the value of ``await self._afetch()`` — before the
+``ast.Await`` unwrap in ``expr_type`` the receiver was untyped and the
+rule was silent."""
+import time
+
+
+class Extent:
+    def slow_read(self):
+        time.sleep(0.1)
+
+
+class Store:
+    async def _afetch(self) -> Extent:
+        return Extent()
+
+    async def serve(self):
+        extent = await self._afetch()
+        extent.slow_read()

@@ -1,0 +1,19 @@
+"""A counter written from the loop (async handler) and a background
+thread with no common thread lock: classic lost-update race across the
+domain seam."""
+import threading
+
+
+class Gauge:
+    def __init__(self):
+        self.total = 0
+
+    def _drain(self):
+        self.total = 0
+
+    async def serve(self):
+        self.total += 1
+
+    def start(self):
+        t = threading.Thread(target=self._drain, daemon=True)
+        t.start()

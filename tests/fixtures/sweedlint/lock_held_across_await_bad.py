@@ -1,0 +1,20 @@
+"""A threading lock held at an await point: the reactor parks the
+coroutine with the lock still held, so every thread contending it waits
+on loop scheduling."""
+import asyncio
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = {}
+
+    async def refresh(self):
+        with self._mu:
+            data = await self._fetch()
+            self._items.update(data)
+
+    async def _fetch(self):
+        await asyncio.sleep(0)
+        return {}

@@ -1,0 +1,19 @@
+"""Waived flavor of the lock-held-at-await fixture."""
+import asyncio
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = {}
+
+    async def refresh(self):
+        with self._mu:
+            # sweedlint: ok lock-held-across-await single-threaded test harness; no thread ever contends this lock
+            data = await self._fetch()
+            self._items.update(data)
+
+    async def _fetch(self):
+        await asyncio.sleep(0)
+        return {}

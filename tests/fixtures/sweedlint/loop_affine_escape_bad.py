@@ -1,0 +1,19 @@
+"""A loop-affine object (async stream body over a per-loop pooled
+socket) handed to a background thread: off-loop code cannot legally
+drive its awaitables."""
+import threading
+
+
+class AStreamBody:
+    async def read(self, n=-1):
+        return b""
+
+
+class Proxy:
+    async def relay(self):
+        body = AStreamBody()
+        t = threading.Thread(target=self._consume, args=(body,))
+        t.start()
+
+    def _consume(self, body):
+        pass

@@ -385,6 +385,51 @@ def test_flume_close_read_poisons_producer():
     run(main())
 
 
+def test_flume_close_read_rejects_queued_ops():
+    """A queued entry carrying a waiter (the ``_SendfileOp`` shape) is
+    rejected on close_read, not silently dropped — dropping it leaves
+    the producer thread parked forever in ``op.wait()`` on an event
+    nobody will ever set."""
+
+    class Op:
+        def __init__(self):
+            self._evt = threading.Event()
+            self._exc = None
+
+        def reject(self, exc):
+            self._exc = exc
+            self._evt.set()
+
+        def wait(self):
+            self._evt.wait()
+            if self._exc is not None:
+                raise self._exc
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        flume = ThreadFlume(loop, window=4)
+        op = Op()
+        outcome = []
+
+        def producer():
+            flume.put(op)
+            try:
+                op.wait()
+                outcome.append("resolved")
+            except ThreadFlumeClosed:
+                outcome.append("rejected")
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        await asyncio.sleep(0.05)  # op is queued; no pump ever drains it
+        flume.close_read()
+        t.join(5)
+        assert not t.is_alive(), "producer still parked in op.wait()"
+        assert outcome == ["rejected"]
+
+    run(main())
+
+
 def test_flume_get_returns_none_at_eos():
     async def main():
         loop = asyncio.get_running_loop()

@@ -101,6 +101,41 @@ def test_needle_cache_disabled_and_resize():
     assert c.stats()["entries"] == 0 and not c.enabled
 
 
+def test_needle_cache_concurrent_resize_stays_coherent(monkeypatch):
+    """Two racing resizes (admin POST vs. lifecycle autopilot) must
+    leave every shard budget agreeing with the winning total capacity —
+    before set_capacity was serialized, the interleaved per-shard loops
+    left a mix of both totals behind."""
+    from seaweedfs_tpu.util.needle_cache import _Shard
+
+    # widen the per-shard loop so the two resizes genuinely overlap
+    orig_resize = _Shard.resize
+    monkeypatch.setattr(
+        _Shard, "resize",
+        lambda self, cap: (time.sleep(0.0005), orig_resize(self, cap)),
+    )
+    c = NeedleCache(capacity_bytes=1 << 20, shards=32)
+    for round_ in range(3):
+        barrier = threading.Barrier(2)
+
+        def resize(cap):
+            barrier.wait()
+            c.set_capacity(cap)
+
+        ts = [
+            threading.Thread(target=resize, args=(cap,))
+            for cap in (1 << 20, 2 << 20)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        per_shard = c.capacity // 32
+        assert all(s.capacity == per_shard for s in c._shards), (
+            round_, c.capacity, {s.capacity for s in c._shards},
+        )
+
+
 def test_needle_cache_eviction_budget():
     c = NeedleCache(capacity_bytes=16 * 100, shards=1)  # one 1600B shard
     for i in range(100):

@@ -321,6 +321,11 @@ def test_real_interlock_reads_serving_gauge(monkeypatch):
             return 600
 
     busy = Busy()
+    # isolate the process-wide WeakSet: earlier suites can leave live
+    # keep-alive servers registered, which would skew the exact total
+    saved = list(SERVING._servers)
+    for s in saved:
+        SERVING._servers.discard(s)
     SERVING.register_server(busy)
     try:
         monkeypatch.setenv("SWEED_MAX_INFLIGHT", "1000")
@@ -332,6 +337,8 @@ def test_real_interlock_reads_serving_gauge(monkeypatch):
         assert allowed
     finally:
         SERVING._servers.discard(busy)
+        for s in saved:
+            SERVING._servers.add(s)
 
 
 def test_pause_resume(mk):

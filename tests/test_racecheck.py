@@ -1,0 +1,362 @@
+"""Cross-domain race detector: domain-classification corner cases
+(analysis/domaingraph.py), the runtime sanitizer (util/racecheck.py),
+and the dynamic ⊆ static cross-check over the serving/QoS/lifecycle
+suites — the lock-order protocol of tests/test_lock_order.py applied
+at the loop/thread boundary.
+
+The unit tests build one-module Projects from inline sources (the
+relpath carries a ``server/`` prefix so the race rule's scope filter
+admits them).  The sanitizer tests flip ``SWEED_RACE_CHECK`` via
+monkeypatch — :func:`instrument` reads the environment per call, so an
+in-process class defined inside the test picks the knob up; the
+product classes imported at session start stay unwrapped, which the
+zero-overhead tests assert directly.
+"""
+
+from __future__ import annotations
+
+import ast
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from seaweedfs_tpu.analysis.callgraph import Project
+from seaweedfs_tpu.analysis.domaingraph import (
+    BACKGROUND,
+    HANDLER,
+    LOOP,
+    compute_domains,
+)
+from seaweedfs_tpu.analysis.racecheck import compute_race_report
+from seaweedfs_tpu.util import racecheck as rt
+from seaweedfs_tpu.util.locks import OrderedLock
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PACKAGE = os.path.join(REPO, "seaweedfs_tpu")
+FIXDIR = os.path.join(HERE, "fixtures", "sweedlint")
+
+
+def _project(src: str, relpath: str = "server/fixture.py") -> Project:
+    proj = Project()
+    proj.add_module(relpath, ast.parse(src), src.splitlines())
+    return proj
+
+
+def _domains(src: str):
+    return compute_domains(_project(src))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observations():
+    rt.reset_observed()
+    yield
+    rt.reset_observed()
+
+
+# -- domain classification corner cases ---------------------------------------
+
+def test_run_in_executor_target_is_handler():
+    dg = _domains(
+        "def work():\n"
+        "    pass\n"
+        "async def route(loop, pool):\n"
+        "    await loop.run_in_executor(pool, work)\n"
+    )
+    assert dg.domains_of("server.fixture.work") == frozenset({HANDLER})
+    assert dg.domains_of("server.fixture.route") == frozenset({LOOP})
+
+
+def test_copy_context_run_bridge_unwraps_to_real_target():
+    """``run_in_executor(pool, ctx.run, f)`` must classify f, not the
+    ``run`` bound method it hides behind."""
+    dg = _domains(
+        "from contextvars import copy_context\n"
+        "def work():\n"
+        "    pass\n"
+        "async def route(loop, pool):\n"
+        "    await loop.run_in_executor(pool, copy_context().run, work)\n"
+    )
+    assert dg.domains_of("server.fixture.work") == frozenset({HANDLER})
+
+
+def test_inline_ctx_run_stays_in_calling_domain():
+    """``ctx.run(f)`` called inline executes f right here: the caller's
+    domain propagates as an ordinary call edge, no bridge hop."""
+    dg = _domains(
+        "from contextvars import copy_context\n"
+        "def work():\n"
+        "    pass\n"
+        "def pump(ctx):\n"
+        "    ctx.run(work)\n"
+        "def start(self):\n"
+        "    import threading\n"
+        "    threading.Thread(target=pump).start()\n"
+    )
+    assert dg.domains_of("server.fixture.work") == frozenset({BACKGROUND})
+
+
+def test_flume_producer_and_loop_consumer_make_put_multi_domain():
+    """The ThreadFlume shape: a background producer thread and a loop
+    coroutine both call ``put`` — the method is genuinely multi-domain
+    and its unguarded attribute writes become race candidates."""
+    src = (
+        "import threading\n"
+        "class Flume:\n"
+        "    def put(self, item):\n"
+        "        self.item = item\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self.flume = Flume()\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._produce).start()\n"
+        "    def _produce(self):\n"
+        "        self.flume.put(1)\n"
+        "    async def consume(self):\n"
+        "        self.flume.put(0)\n"
+    )
+    dg = _domains(src)
+    assert dg.domains_of("server.fixture.Flume.put") == frozenset(
+        {BACKGROUND, LOOP}
+    )
+    assert "Flume.item" in {c.name for c in compute_race_report(_project(src))}
+
+
+def test_lambda_thread_target_callees_are_background():
+    dg = _domains(
+        "import threading\n"
+        "def work(n):\n"
+        "    pass\n"
+        "def start():\n"
+        "    threading.Thread(target=lambda: work(3)).start()\n"
+    )
+    assert dg.domains_of("server.fixture.work") == frozenset({BACKGROUND})
+
+
+def test_handler_method_and_async_def_roots():
+    dg = _domains(
+        "class H:\n"
+        "    def _h_status(self):\n"
+        "        helper()\n"
+        "async def tick():\n"
+        "    helper()\n"
+        "def helper():\n"
+        "    pass\n"
+    )
+    assert dg.domains_of("server.fixture.helper") == frozenset(
+        {HANDLER, LOOP}
+    )
+    assert dg.label("server.fixture.helper") == "multi(handler+loop)"
+
+
+# -- runtime sanitizer: zero overhead when disabled ----------------------------
+
+_DISABLED = os.environ.get("SWEED_RACE_CHECK", "") != "1"
+
+
+@pytest.mark.skipif(not _DISABLED, reason="suite running under sanitizer")
+def test_instrument_is_identity_when_disabled():
+    class C:
+        pass
+
+    assert rt.instrument(C) is C
+    assert "__setattr__" not in vars(C)
+    assert not hasattr(C, "__sweed_race_wrapped__")
+
+
+@pytest.mark.skipif(not _DISABLED, reason="suite running under sanitizer")
+def test_production_classes_carry_no_wrapper_when_disabled():
+    """The compiled-out guarantee: with SWEED_RACE_CHECK unset the
+    instrumented product classes have an untouched __setattr__ — the
+    steady-state write path pays nothing."""
+    from seaweedfs_tpu.stats.metrics import Counter
+    from seaweedfs_tpu.util.aio_pipeline import ThreadFlume
+    from seaweedfs_tpu.util.needle_cache import NeedleCache
+
+    for cls in (ThreadFlume, NeedleCache, Counter):
+        assert "__setattr__" not in vars(cls), cls.__name__
+        assert not hasattr(cls, "__sweed_race_wrapped__"), cls.__name__
+
+
+# -- runtime sanitizer: enabled-path semantics --------------------------------
+
+def test_sanitizer_observes_unlocked_cross_domain_write(monkeypatch):
+    monkeypatch.setenv("SWEED_RACE_CHECK", "1")
+
+    @rt.instrument
+    class Seeded:
+        def __init__(self):
+            self.total = 0
+
+    s = Seeded()
+    s.total = 1  # background: main thread, no loop
+
+    async def bump():
+        s.total += 1
+
+    asyncio.run(bump())
+    obs = {o["name"]: o for o in rt.observations()}
+    assert "Seeded.total" in obs
+    assert set(obs["Seeded.total"]["domains"]) == {rt.BACKGROUND, rt.LOOP}
+
+
+def test_sanitizer_single_domain_writes_stay_silent(monkeypatch):
+    monkeypatch.setenv("SWEED_RACE_CHECK", "1")
+
+    @rt.instrument
+    class Solo:
+        def __init__(self):
+            self.n = 0
+
+    s = Solo()
+    for _ in range(3):
+        s.n += 1
+    assert rt.observations() == []
+
+
+def test_sanitizer_common_lock_suppresses_observation(monkeypatch):
+    """Eraser semantics: both domains hold the same named lock at every
+    write, so the candidate lockset never empties."""
+    monkeypatch.setenv("SWEED_RACE_CHECK", "1")
+    mu = OrderedLock("Guarded._mu")
+
+    @rt.instrument
+    class Guarded:
+        pass
+
+    g = Guarded.__new__(Guarded)
+    with mu:
+        g.total = 1  # background
+
+    async def bump():
+        with mu:
+            g.total = 2  # loop, same lock held
+
+    asyncio.run(bump())
+    assert rt.observations() == []
+
+
+def test_sanitizer_domain_probes(monkeypatch):
+    monkeypatch.setenv("SWEED_RACE_CHECK", "1")
+    assert rt.current_domain() == rt.BACKGROUND
+
+    seen = []
+    t = threading.Thread(
+        target=lambda: seen.append(rt.current_domain()),
+        name=rt.HANDLER_THREAD_PREFIX + "-probe",
+    )
+    t.start()
+    t.join()
+    assert seen == [rt.HANDLER]
+
+    async def probe():
+        return rt.current_domain()
+
+    assert asyncio.run(probe()) == rt.LOOP
+
+
+# -- the seeded race: one fixture caught by BOTH halves -----------------------
+
+def test_seeded_race_fixture_caught_statically_and_dynamically(monkeypatch):
+    """tests/fixtures/sweedlint/cross_domain_race_bad.py is the seeded
+    race: the static rule must flag Gauge.total, and executing the very
+    same source under the sanitizer must observe the same name."""
+    src = open(
+        os.path.join(FIXDIR, "cross_domain_race_bad.py"), encoding="utf-8"
+    ).read()
+
+    static = {
+        c.name
+        for c in compute_race_report(
+            _project(src, "server/cross_domain_race_bad.py")
+        )
+    }
+    assert "Gauge.total" in static
+
+    monkeypatch.setenv("SWEED_RACE_CHECK", "1")
+    ns: dict = {}
+    exec(compile(src, "cross_domain_race_bad.py", "exec"), ns)
+    Gauge = rt.instrument(ns["Gauge"])
+    g = Gauge()
+    t = threading.Thread(target=g._drain, daemon=True)
+    t.start()
+    t.join()
+    asyncio.run(g.serve())
+
+    dynamic = {o["name"] for o in rt.observations()}
+    assert "Gauge.total" in dynamic
+    assert dynamic <= static
+
+
+# -- dynamic ⊆ static over the real suites ------------------------------------
+
+def _static_candidates() -> set[str]:
+    from seaweedfs_tpu.analysis import _iter_py_files
+
+    proj = Project()
+    for path, rel in _iter_py_files(PACKAGE):
+        src = open(path, encoding="utf-8").read()
+        proj.add_module(rel, ast.parse(src), src.splitlines())
+    return {c.name for c in compute_race_report(proj)}
+
+
+def test_serving_suites_sanitizer_dynamic_subset_of_static(tmp_path):
+    """Run the serving/QoS/lifecycle suites with both sanitizers on and
+    assert every dynamically observed cross-domain location appears in
+    the static pre-waiver candidate set (compute_race_report).  A
+    dynamic-only name means the static analysis lost a path — fix
+    analysis/{callgraph,domaingraph,racecheck}.py, never the test."""
+    dump = tmp_path / "racedump.json"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SWEED_RACE_CHECK="1",
+        SWEED_LOCK_CHECK="1",
+        SWEED_RACE_DUMP=str(dump),
+    )
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_serving.py",
+            "tests/test_qos.py",
+            "tests/test_lifecycle.py",
+            # the c=256 bench probes drive the same handlers the wire-
+            # parity tests already cross (load adds no lockset
+            # information, only wall-clock) — skip them here
+            "-k",
+            "not bench_probe",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+            "-p",
+            "no:randomly",
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO,
+        timeout=600,
+    )
+    assert r.returncode == 0, (
+        "serving suites failed under SWEED_RACE_CHECK=1:\n"
+        + r.stdout[-4000:]
+        + r.stderr[-2000:]
+    )
+    assert dump.exists(), "sanitizer wrote no dump — instrument() inactive?"
+    snap = json.loads(dump.read_text())
+    observed = {o["name"] for o in snap["observations"]}
+
+    static = _static_candidates()
+    missing = observed - static
+    assert not missing, (
+        "dynamically observed cross-domain writes absent from the static "
+        f"candidate set: {sorted(missing)} — the call-graph or domain "
+        "classification lost a path (static must stay ⊇ dynamic)"
+    )

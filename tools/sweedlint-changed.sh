@@ -1,0 +1,20 @@
+#!/bin/sh
+# Pre-commit wrapper for sweedlint's --changed mode: lint only the
+# package files that differ from merge-base(HEAD, origin/main) plus
+# uncommitted edits.  Fast inner loop; the tier-1 gate
+# (tests/test_sweedlint.py::test_gate_package_is_clean_against_baseline)
+# stays authoritative because interprocedural rules see the whole tree
+# only there.
+#
+# Install:  ln -s ../../tools/sweedlint-changed.sh .git/hooks/pre-commit
+# Usage:    tools/sweedlint-changed.sh [BASE]   (default: origin/main,
+#           then main, then HEAD — the same fallback the CLI applies)
+set -eu
+
+repo_root=$(cd "$(dirname "$0")/.." && pwd)
+cd "$repo_root"
+
+if [ "$#" -gt 0 ]; then
+    exec env JAX_PLATFORMS=cpu python -m seaweedfs_tpu.analysis --changed "$1"
+fi
+exec env JAX_PLATFORMS=cpu python -m seaweedfs_tpu.analysis --changed
