@@ -30,6 +30,10 @@ import subprocess
 import sys
 import time
 
+# retry-bind port plumbing shared with the chaos harnesses (util/netports):
+# every subprocess-cluster probe allocates through one helper
+from seaweedfs_tpu.util.netports import free_port  # noqa: E402
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -337,15 +341,6 @@ def probe_smallfile(n: int, c: int) -> None:
     from seaweedfs_tpu.server.master_server import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
 
-    def free_port():
-        import socket
-
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
     with tempfile.TemporaryDirectory() as tmp:
         ms = MasterServer(host="127.0.0.1", port=free_port()).start()
         vs = VolumeServer([tmp], host="127.0.0.1", port=free_port(),
@@ -387,13 +382,6 @@ def probe_filer_pipe(size_mb: int, window: int, chunk_mb: int = 4) -> None:
     import numpy as np
 
     from seaweedfs_tpu.filer.client import FilerClient
-
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
 
     def wait_port(port, timeout=20.0):
         deadline = time.perf_counter() + timeout
@@ -575,13 +563,6 @@ def probe_serving(mode: str, conns_csv: str, total: int) -> None:
     import urllib.request
 
     from seaweedfs_tpu.filer.client import FilerClient
-
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
 
     def wait_port(port, timeout=20.0):
         deadline = time.perf_counter() + timeout
@@ -1036,13 +1017,6 @@ def probe_trace(total: int = 8000, conns: int = 16) -> None:
 
     from seaweedfs_tpu.filer.client import FilerClient
 
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
     def wait_port(port, timeout=20.0):
         deadline = time.perf_counter() + timeout
         while time.perf_counter() < deadline:
@@ -1307,13 +1281,6 @@ def probe_hotshard(n_needles: int, n_requests: int) -> None:
         from seaweedfs_tpu.storage.file_id import FileId
 
         return str(FileId(vol_of(i), i + 1, cookie_of(i)))
-
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
 
     def wait_port(port, timeout=30.0):
         deadline = time.perf_counter() + timeout
@@ -1625,13 +1592,6 @@ def probe_lifecycle(n_files: int = 64, n_requests: int = 4000) -> None:
     from seaweedfs_tpu.server.http_util import http_bytes, http_json
     from seaweedfs_tpu.storage.backend.fake_s3 import FakeS3Server
 
-    def free_port():
-        s = _socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
     def payload_of(i: int) -> bytes:
         return (b"lifecycle:%06d|" % i) * PAYLOAD_REPS
 
@@ -1887,13 +1847,6 @@ def probe_sync(n_files: int = 120, outage_s: float = 6.0) -> None:
     from seaweedfs_tpu.server.master_server import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
 
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
     def tree(url):
         fc = FilerClient(url)
         out, stack = {}, ["/sync/"]
@@ -2009,6 +1962,221 @@ def probe_sync(n_files: int = 120, outage_s: float = 6.0) -> None:
                     pass
     print(json.dumps(out))
 
+
+def probe_meta(n_files: int = 480, c: int = 16) -> None:
+    """Child mode: metadata-plane scale-out — the same create/lookup storm
+    against a 1-filer and a 4-filer fleet. Each filer is a SEPARATE process
+    over its own sqlite store (in one process the GIL serializes the very
+    stores the ring spreads load across); `ring_peers` wires the 4-fleet
+    into a ring. A 3ms delay faultpoint armed INSIDE the filer's
+    create_entry lock models a loaded metadata store — the serialization
+    point sharding exists to scale past; both fleet sizes run the same
+    instrumented path. Workers pull shuffled paths off one shared queue so
+    load spreads over the fleet the way real traffic does, instead of
+    pinning each thread to a shard. After the storm the tree must read
+    identically through every gateway shape: the smart ring client, a dumb
+    307-following client aimed at EVERY member (spine listings fan out
+    server-side), and the S3 gateway. Prints one JSON line with creates/s
+    + lookups/s per fleet size and the scaling factor."""
+    import concurrent.futures
+    import queue
+    import random
+    import socket
+    import tempfile
+    import urllib.request
+
+    from seaweedfs_tpu.filer.client import FilerClient
+    from seaweedfs_tpu.filer.ring import RingFilerClient
+
+    def wait_port(port, timeout=20.0):
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                return
+            except OSError:
+                time.sleep(0.1)
+        raise RuntimeError(f"server on :{port} never came up")
+
+    def spawn(code, extra_env=None):
+        env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
+        return subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+
+    # modeled store latency per create, held under the filer metadata lock
+    # (the real serialization point): same method as the filer-pipe probe's
+    # modeled needle RTT. On this often single-core bench rig every
+    # python/sqlite instruction is CPU-serialized across the whole fleet,
+    # so the modeled wait must DOMINATE the ~3ms real per-op cost — 20ms
+    # (a loaded metadata store's commit: fsync + WAL contention) is what
+    # sharding genuinely overlaps, exactly as a pipeline overlaps waiting
+    store_ms = 20.0
+    fault_env = {
+        "SWEED_FAULTPOINTS": f"filer.meta.create=delay:{store_ms / 1e3}::0",
+    }
+    # the tree lives where the S3 gateway can see it (/buckets/<bucket>);
+    # depth 3 makes /buckets/bench/dNN the shard key, so the 16 dirs
+    # spread over the fleet — exported here so the parent-side ring
+    # clients AND the spawned filers (env-inherited) agree on the split
+    os.environ["SWEED_RING_DEPTH"] = "3"
+    root = "/buckets/bench"
+    paths = [f"{root}/d{i % 16:02d}/f{i:05d}.txt" for i in range(n_files)]
+    shuffled = list(paths)
+    random.Random(7).shuffle(shuffled)
+
+    def run_fleet(n_filers):
+        procs = []
+        with tempfile.TemporaryDirectory() as tmp:
+            try:
+                mp = free_port()
+                procs.append(spawn(
+                    "import time\n"
+                    "from seaweedfs_tpu.server.master_server import MasterServer\n"
+                    f"MasterServer(host='127.0.0.1', port={mp}).start()\n"
+                    "time.sleep(3600)\n"
+                ))
+                fports = [free_port() for _ in range(n_filers)]
+                ring = [f"127.0.0.1:{p}" for p in fports]
+                wait_port(mp)
+                for i, fp in enumerate(fports):
+                    peers = ring if n_filers > 1 else None
+                    procs.append(spawn(
+                        "import time\n"
+                        "from seaweedfs_tpu.server.filer_server import FilerServer\n"
+                        f"FilerServer(host='127.0.0.1', port={fp}, "
+                        f"master_url='127.0.0.1:{mp}', "
+                        f"db_path={os.path.join(tmp, f'filer{i}.db')!r}, "
+                        f"ring_peers={peers!r}).start()\n"
+                        "time.sleep(3600)\n",
+                        extra_env=fault_env,
+                    ))
+                for fp in fports:
+                    wait_port(fp)
+                time.sleep(0.5)
+
+                def storm(op):
+                    # shared queue: every worker's NEXT request lands on
+                    # whatever shard its path hashes to, so the fleet
+                    # stays uniformly loaded
+                    work = queue.Queue()
+                    for p in shuffled:
+                        work.put(p)
+
+                    def worker():
+                        rc = RingFilerClient(ring)
+                        while True:
+                            try:
+                                p = work.get_nowait()
+                            except queue.Empty:
+                                return
+                            op(rc, p)
+
+                    with concurrent.futures.ThreadPoolExecutor(c) as pool:
+                        t0 = time.perf_counter()
+                        futs = [pool.submit(worker) for _ in range(c)]
+                        for f in futs:
+                            f.result()
+                        return time.perf_counter() - t0
+
+                now = int(time.time())
+                create_s = storm(lambda rc, p: rc.create_entry(p, {
+                    "full_path": p, "is_directory": False,
+                    "mtime": now, "chunks": [],
+                }))
+
+                def lookup(rc, p):
+                    if rc.get_entry(p) is None:
+                        raise RuntimeError(f"lookup miss: {p}")
+
+                lookup_s = storm(lookup)
+
+                # -- identical through every gateway shape ----------------
+                def gateway_tree(client):
+                    # the DUMB surface: follows 307s to shard owners,
+                    # spine listings fan out + merge server-side
+                    out, stack = {}, [root]
+                    while stack:
+                        d = stack.pop()
+                        for e in client.list(d, limit=10_000):
+                            p = f"{d}/{e['name']}"
+                            if e.get("is_directory"):
+                                stack.append(p)
+                            else:
+                                out[p] = json.dumps(
+                                    e.get("chunks", []), sort_keys=True)
+                    return out
+
+                want = gateway_tree(RingFilerClient(ring))
+                assert len(want) == n_files, (len(want), n_files)
+                gateways_ok = all(
+                    gateway_tree(FilerClient(m)) == want for m in ring
+                )
+                sp = free_port()
+                procs.append(spawn(
+                    "import time\n"
+                    "from seaweedfs_tpu.s3api import S3ApiServer\n"
+                    f"S3ApiServer(port={sp}, "
+                    f"filer_url={','.join(ring)!r}).start()\n"
+                    "time.sleep(3600)\n"
+                ))
+                wait_port(sp)
+                keys = set()
+                token = ""
+                while True:  # ListObjectsV2 pages through the ring client
+                    url = (f"http://127.0.0.1:{sp}/bench?list-type=2"
+                           f"&max-keys=1000{token}")
+                    with urllib.request.urlopen(url, timeout=20) as r:
+                        xml = r.read().decode()
+                    import re
+                    keys.update(re.findall(r"<Key>([^<]+)</Key>", xml))
+                    m = re.search(
+                        r"<NextContinuationToken>([^<]+)"
+                        r"</NextContinuationToken>", xml)
+                    if not m:
+                        break
+                    token = "&continuation-token=" + urllib.parse.quote(
+                        m.group(1))
+                s3_ok = keys == {p[len(root) + 1:] for p in paths}
+                return {
+                    "filers": n_filers,
+                    "creates_per_s": round(n_files / create_s, 1),
+                    "lookups_per_s": round(n_files / lookup_s, 1),
+                    "gateways_identical": bool(gateways_ok),
+                    "s3_keys_match": bool(s3_ok),
+                }
+            finally:
+                for p in procs:
+                    p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+
+    one = run_fleet(1)
+    four = run_fleet(4)
+    print(json.dumps({
+        "n_files": n_files,
+        "concurrency": c,
+        "modeled_store_ms": store_ms,
+        "host_cores": os.cpu_count(),
+        "note": (
+            "creates are the scaling metric (the modeled store wait is "
+            "what sharding overlaps); lookups are unmodeled and "
+            "client/CPU-bound on a small rig"
+        ),
+        "fleet_1": one,
+        "fleet_4": four,
+        "create_scaling_x": round(
+            four["creates_per_s"] / max(one["creates_per_s"], 0.1), 2),
+        "lookup_scaling_x": round(
+            four["lookups_per_s"] / max(one["lookups_per_s"], 0.1), 2),
+    }))
 
 class _NullSink:
     """File-like that discards writes: isolates read+H2D+compute+D2H from
@@ -2738,6 +2906,25 @@ def main() -> None:
     except subprocess.TimeoutExpired:
         log("sync probe timed out")
 
+    # -- sharded filer fleet: metadata-plane scale-out -----------------------
+    meta_bench = None
+    try:
+        r = _run_probe(["--probe-meta", "480", "16"], timeout=420)
+        if r.returncode == 0 and r.stdout.strip():
+            meta_bench = json.loads(r.stdout.strip().splitlines()[-1])
+            log(
+                f"meta: creates {meta_bench['fleet_1']['creates_per_s']}/s "
+                f"(1 filer) -> {meta_bench['fleet_4']['creates_per_s']}/s "
+                f"(4 filers) = {meta_bench['create_scaling_x']}x, gateways "
+                f"identical={meta_bench['fleet_4']['gateways_identical']}, "
+                f"s3 keys match={meta_bench['fleet_4']['s3_keys_match']}"
+            )
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or [""]
+            log(f"meta probe failed: {tail[0][:140]}")
+    except subprocess.TimeoutExpired:
+        log("meta probe timed out")
+
     # -- lifecycle autopilot: drifting hot set, live re-tiering --------------
     lifecycle_bench = None
     try:
@@ -2989,6 +3176,7 @@ def main() -> None:
                 "trace": trace_bench,
                 "hotshard": hotshard,
                 "sync": sync_bench,
+                "meta_shard": meta_bench,
                 "lifecycle": lifecycle_bench,
                 "e2e": e2e,
                 "e2e_note": (
@@ -3045,6 +3233,9 @@ if __name__ == "__main__":
     elif sys.argv[1:2] == ["--probe-lifecycle"]:
         probe_lifecycle(int(sys.argv[2]) if len(sys.argv) > 2 else 64,
                         int(sys.argv[3]) if len(sys.argv) > 3 else 4000)
+    elif sys.argv[1:2] == ["--probe-meta"]:
+        probe_meta(int(sys.argv[2]) if len(sys.argv) > 2 else 480,
+                   int(sys.argv[3]) if len(sys.argv) > 3 else 16)
     elif sys.argv[1:2] == ["--probe-hotshard"]:
         probe_hotshard(
             int(sys.argv[2]) if len(sys.argv) > 2 else 2_000_000,

@@ -859,6 +859,75 @@ class MaintenanceWithoutInterlock:
         return out
 
 
+class DeadlinePropagation:
+    """Cross-daemon HTTP done with raw stdlib primitives
+    (``urllib.request.urlopen``, bare ``http.client`` connections) or the
+    ``requests`` package bypasses the deadline-propagating transports, so
+    the caller's ``X-Sweed-Deadline`` dies at that hop: the downstream
+    daemon keeps grinding on work whose requester already gave up, which
+    is exactly the tail-amplification the cross-daemon deadline exists to
+    stop. The sanctioned transports — ``server.http_util`` on threads,
+    ``server.aio_transport`` on the loop — inject the ambient deadline
+    header and clamp the socket timeout to the remaining budget on every
+    request.
+
+    The two transport modules themselves are exempt (they wrap the raw
+    primitives to DO the propagation). Hops that must NOT carry the
+    internal deadline — egress to third-party services like cloud sinks
+    or webhook endpoints — keep the raw call and waive with that reason.
+    """
+
+    name = "deadline-not-propagated"
+
+    _EXEMPT = ("server/http_util.py", "server/aio_transport.py")
+
+    #: raw call names that open an HTTP exchange without the deadline
+    _RAW = frozenset({"urlopen", "HTTPConnection", "HTTPSConnection"})
+
+    #: requests.<verb>(...) — same bypass, different package
+    _REQUESTS_VERBS = frozenset(
+        {"get", "post", "put", "delete", "head", "patch", "request"}
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        return not any(relpath.endswith(e) for e in self._EXEMPT)
+
+    def check(self, tree: ast.Module, relpath: str) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_raw_http(node):
+                out.append(
+                    Violation(
+                        self.name,
+                        relpath,
+                        node.lineno,
+                        "raw HTTP call drops the ambient deadline; use "
+                        "server.http_util (threads) or "
+                        "server.aio_transport (event loop) so "
+                        "X-Sweed-Deadline and the timeout clamp ride "
+                        "along, or waive with the reason this hop must "
+                        "not carry the internal deadline",
+                    )
+                )
+        return out
+
+    def _is_raw_http(self, call: ast.Call) -> bool:
+        name = _func_name(call)
+        if name in self._RAW:
+            return True
+        # requests.get(...) / requests.post(...) — only when the receiver
+        # is literally the requests module, so obj.get(key) stays quiet
+        f = call.func
+        return (
+            isinstance(f, ast.Attribute)
+            and f.attr in self._REQUESTS_VERBS
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "requests"
+        )
+
+
 RULES = [
     LockDiscipline(),
     Durability(),
@@ -869,4 +938,5 @@ RULES = [
     UnboundedRetry(),
     MetricCardinality(),
     MaintenanceWithoutInterlock(),
+    DeadlinePropagation(),
 ]

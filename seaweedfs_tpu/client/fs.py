@@ -23,8 +23,9 @@ from typing import Optional
 from fsspec import AbstractFileSystem
 from fsspec.spec import AbstractBufferedFile
 
-from ..filer.client import FilerClient
+from ..filer.client import FilerClient  # noqa: F401 — re-exported for callers
 from ..filer.entry import Entry
+from ..filer.ring import make_client
 
 
 def _entry_info(d: dict, path: str) -> dict:
@@ -66,7 +67,10 @@ class SeaweedFileSystem(AbstractFileSystem):
     ):
         super().__init__(**kwargs)
         self.filer = filer
-        self.client = FilerClient(filer)
+        # "host:p1,host:p2" (or a list) → ring-aware client that routes
+        # each path to its owning filer; one address stays the plain
+        # FilerClient (filer/ring.py make_client)
+        self.client = make_client(filer)
         self.chunk_size = chunk_size
         self.collection = collection
         self.ttl = ttl

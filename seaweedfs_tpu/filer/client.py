@@ -40,6 +40,16 @@ class FilerClient:
         # gateway) own retry policy and double-retrying multiplies load
         self._read_policy = READ_POLICY if retry_reads else None
 
+    @staticmethod
+    def _redirect_location(status: int, hdrs: dict) -> Optional[str]:
+        """A sharded filer fleet answers reads for foreign paths with
+        ``307 Location:`` (filer_server ring gate); a dumb client follows
+        that ONE hop — the target answers with noRedirect, so there is
+        never a chain. Writes aren't followed: the filer proxies those."""
+        if status in (301, 302, 307, 308):
+            return hdrs.get("Location") or hdrs.get("location")
+        return None
+
     def _read(self, fn, *args, **kwargs):
         if self._read_policy is None:
             return fn(*args, **kwargs)
@@ -130,18 +140,39 @@ class FilerClient:
         instead of buffering whole objects (pairs with the filer's
         streaming read path). The caller must .close() the response; error
         statuses return the (small) error body as bytes instead."""
-        return http_stream_response(
-            "GET", self._u(path),
-            headers={"Range": rng} if rng else None, timeout=600,
+        headers = {"Range": rng} if rng else None
+        status, body, hdrs = http_stream_response(
+            "GET", self._u(path), headers=headers, timeout=600,
         )
+        loc = self._redirect_location(status, hdrs)
+        if loc:
+            if hasattr(body, "read"):
+                try:
+                    body.read()  # tiny JSON; settle framing → repool
+                finally:
+                    body.close()
+            status, body, hdrs = http_stream_response(
+                "GET", loc, headers=headers, timeout=600,
+            )
+        return status, body, hdrs
 
     def get_object(
         self, path: str, rng: Optional[str] = None
     ) -> tuple[int, bytes, dict]:
-        return self._read(
-            http_bytes_headers, "GET", self._u(path),
-            headers={"Range": rng} if rng else None, timeout=60,
-        )
+        headers = {"Range": rng} if rng else None
+
+        def go():
+            status, data, hdrs = http_bytes_headers(
+                "GET", self._u(path), headers=headers, timeout=60,
+            )
+            loc = self._redirect_location(status, hdrs)
+            if loc:
+                status, data, hdrs = http_bytes_headers(
+                    "GET", loc, headers=headers, timeout=60,
+                )
+            return status, data, hdrs
+
+        return self._read(go)
 
     def select(self, path: str, request_xml: bytes) -> tuple[int, bytes, dict]:
         """POST the raw SelectObjectContent request XML to the filer's
@@ -167,7 +198,16 @@ class FilerClient:
 
     # -- entry level ----------------------------------------------------------
     def get_entry(self, path: str) -> Optional[dict]:
-        status, body = self._read(http_bytes, "GET", self._u(path, meta="true"))
+        def go():
+            status, body, hdrs = http_bytes_headers(
+                "GET", self._u(path, meta="true")
+            )
+            loc = self._redirect_location(status, hdrs)
+            if loc:
+                status, body, hdrs = http_bytes_headers("GET", loc)
+            return status, body
+
+        status, body = self._read(go)
         if status != 200:
             return None
         return json.loads(body)
@@ -218,17 +258,22 @@ class FilerClient:
         limit: int = 1000,
         prefix: str = "",
     ) -> list[dict]:
-        status, body = self._read(
-            http_bytes,
-            "GET",
-            self._u(
-                dir_path.rstrip("/") + "/",
-                meta="true",
-                lastFileName=start_after,
-                limit=str(limit),
-                prefix=prefix,
-            ),
+        url = self._u(
+            dir_path.rstrip("/") + "/",
+            meta="true",
+            lastFileName=start_after,
+            limit=str(limit),
+            prefix=prefix,
         )
+
+        def go():
+            status, body, hdrs = http_bytes_headers("GET", url)
+            loc = self._redirect_location(status, hdrs)
+            if loc:
+                status, body, hdrs = http_bytes_headers("GET", loc)
+            return status, body
+
+        status, body = self._read(go)
         if status != 200:
             return []
         return json.loads(body).get("entries", [])

@@ -11,7 +11,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional
 
-from ..util import glog
+from ..util import faultpoints, glog
 from .entry import Entry, FileChunk
 from .filechunks import compact_file_chunks, minus_chunks
 from .filerstore import FilerStore, MemoryStore, NotFoundError
@@ -73,6 +73,10 @@ class Filer:
         signatures: Optional[list[int]] = None,
     ) -> Entry:
         with self._lock:
+            # the per-filer serialization point: a delay armed here models
+            # a loaded metadata store (bench --probe-meta scales past it by
+            # sharding the tree over more filers)
+            faultpoints.fire("filer.meta.create", path=entry.full_path)
             self._ensure_parents(entry.parent)
             old = None
             try:

@@ -91,6 +91,7 @@ class MessagingClient:
         )
         if key:
             req.add_header("X-Msg-Key", base64.b64encode(key).decode())
+        # sweedlint: ok deadline-not-propagated broker pub is fire-and-forget from producers, not a fan of an inbound request; its own timeout bounds it
         with urllib.request.urlopen(req, timeout=30) as resp:
             import json
 

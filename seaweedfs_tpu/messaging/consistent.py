@@ -1,6 +1,22 @@
-"""Consistent-hash ring for partition→broker placement (reference
+"""Consistent-hash ring for partition→member placement (reference
 `messaging/broker/consistent_distribution.go`, which wraps stathat/consistent:
-20 virtual replicas per member, crc-style hashing, lookup by key)."""
+20 virtual replicas per member, crc-style hashing, lookup by key).
+
+Originally broker-only; now load-bearing for the sharded filer fleet
+(filer/ring.py maps directory-tree shard keys onto filers with it), so
+the corner cases are pinned by direct unit tests (test_consistent_ring):
+
+- empty ring: ``get`` raises LookupError (callers own the "no members"
+  story); single member: every key maps to it.
+- determinism: the ring's layout is a pure function of its member SET —
+  add/remove order never changes placement, and re-adding a removed
+  member restores the exact previous layout (a reshard planned against
+  ring A must equal one planned against a reconstructed A).
+- duplicate virtual-node collisions: two members' virtual nodes may hash
+  identically; ties break on the member name, so both survive, lookups
+  stay deterministic, and removing one member never disturbs the other's
+  nodes.
+"""
 
 from __future__ import annotations
 
@@ -16,30 +32,56 @@ def _hash(key: "str | bytes") -> int:
 
 class ConsistentRing:
     def __init__(self, replicas: int = 20):
-        self.replicas = replicas
-        self._ring: list[tuple[int, str]] = []
+        self.replicas = max(1, replicas)
+        # sorted parallel arrays: _keys holds virtual-node hashes, _owners
+        # the member each belongs to. Entries sort by (hash, member) so a
+        # cross-member hash collision keeps BOTH nodes in a stable order
+        # instead of one silently shadowing the other.
+        self._keys: list[int] = []
+        self._owners: list[str] = []
         self._members: set[str] = set()
 
     def add(self, member: str) -> None:
         if member in self._members:
             return
         self._members.add(member)
-        for i in range(self.replicas):
-            self._ring.append((_hash(f"{member}#{i}"), member))
-        self._ring.sort()
+        self._rebuild()
 
     def remove(self, member: str) -> None:
         if member not in self._members:
             return
         self._members.discard(member)
-        self._ring = [(h, m) for h, m in self._ring if m != member]
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        # rebuilt from the member SET every time: layout is independent of
+        # the add/remove sequence by construction
+        ring = sorted(
+            (_hash(f"{member}#{i}"), member)
+            for member in self._members
+            for i in range(self.replicas)
+        )
+        self._keys = [h for h, _ in ring]
+        self._owners = [m for _, m in ring]
 
     def members(self) -> list[str]:
         return sorted(self._members)
 
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def __contains__(self, member: str) -> bool:
+        return member in self._members
+
     def get(self, key: str) -> str:
-        if not self._ring:
+        """The member owning ``key``: first virtual node clockwise of the
+        key's hash. Raises LookupError on an empty ring."""
+        if not self._members:
             raise LookupError("empty ring")
-        h = _hash(key)
-        idx = bisect.bisect_right(self._ring, (h, "￿")) % len(self._ring)
-        return self._ring[idx][1]
+        if len(self._members) == 1:
+            return next(iter(self._members))
+        # bisect_right: a key hashing EXACTLY onto a virtual node walks
+        # past all colliding nodes at that hash — deterministic regardless
+        # of how many members collide there
+        idx = bisect.bisect_right(self._keys, _hash(key)) % len(self._keys)
+        return self._owners[idx]

@@ -20,7 +20,7 @@ import time
 from typing import Optional
 
 from .. import operation
-from ..filer.client import FilerClient
+from ..filer.client import FilerClient  # noqa: F401 — re-exported for callers
 from ..filer.entry import Entry, FileChunk
 from .dirty_pages import ContinuousIntervals
 from .meta_cache import MetaCache
@@ -43,7 +43,12 @@ class WFS:
         read_window: int = 4,
         write_window: int = 4,
     ):
-        self.client = FilerClient(filer_url)
+        # multi-address lists route entry commits by ring ownership —
+        # direct-to-volume data writes are unaffected, but the COMMIT
+        # (create_entry) must land on the path's owning filer
+        from ..filer.ring import make_client
+
+        self.client = make_client(filer_url)
         self.chunk_size = chunk_size
         self.collection = collection
         self.ttl = ttl

@@ -134,6 +134,7 @@ class AzureSink(ReplicationSink):
             headers=headers,
         )
         try:
+            # sweedlint: ok deadline-not-propagated third-party egress; the internal deadline header must not leak to a cloud endpoint
             with urllib.request.urlopen(req, timeout=30) as resp:
                 return resp.status
         except urllib.error.HTTPError as e:

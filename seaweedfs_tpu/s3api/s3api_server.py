@@ -105,7 +105,11 @@ class S3ApiServer:
         tls_ca: str = "",
     ):
         self.host, self.port = host, port
-        self.client = FilerClient(filer_url)
+        # "host:p1,host:p2" → ring-aware routing across the filer fleet;
+        # a single address stays the plain FilerClient (filer/ring.py)
+        from ..filer.ring import make_client
+
+        self.client = make_client(filer_url)
         # object/bucket op latency; op label is method × path-kind (bounded)
         self._req_hist = default_registry.histogram(
             "s3_request_seconds", "s3 gateway request latency"

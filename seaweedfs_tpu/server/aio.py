@@ -54,6 +54,7 @@ from seaweedfs_tpu.util.racecheck import instrument
 from seaweedfs_tpu.util.throttler import GOVERNOR
 
 from ..stats import trace as _trace
+from ..util import deadline as _deadline
 from .http_util import (
     NATIVE_FALLBACK,
     SERVING,
@@ -788,6 +789,15 @@ class AioHTTPServer:
             k: v[0]
             for k, v in urllib.parse.parse_qs(parsed.query).items()
         }
+        # an already-expired budget bridges to the worker path, which
+        # renders the one canonical 504 + cancelled span — the native
+        # core never grows its own error machinery
+        ddl = (_deadline.parse_header(
+            headers.get(_deadline.DEADLINE_HEADER))
+            if _deadline.enabled() else None)
+        if ddl is not None and ddl <= time.time():
+            SERVING.note_native_fallback()
+            return NATIVE_FALLBACK
         req = NativeRequest(method, parsed.path, headers,
                             client_address, self)
         # the span CM is task-scoped contextvars — safe in a coroutine
@@ -798,7 +808,8 @@ class AioHTTPServer:
             path=parsed.path,
         ) as span:
             try:
-                result = await fn(req, parsed.path, query)
+                with _deadline.scope(ddl):
+                    result = await fn(req, parsed.path, query)
             except asyncio.CancelledError:
                 raise
             except Exception:

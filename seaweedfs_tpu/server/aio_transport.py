@@ -36,6 +36,7 @@ import weakref
 from typing import Optional
 
 from ..stats import trace as _trace
+from ..util import deadline as _deadline
 from ..util.throttler import INTERNAL_HEADER
 from .http_util import _IDEMPOTENT_METHODS, pool_max_idle_seconds
 
@@ -128,12 +129,15 @@ def _build_head(method: str, u, headers: dict, body_len: int) -> bytes:
 
 
 def _outbound_headers(headers: Optional[dict]) -> dict:
-    """Trace + internal-hop markers, same injection contract as
-    http_util._trace_headers (caller-set headers win)."""
+    """Trace + deadline + internal-hop markers, same injection contract
+    as http_util._trace_headers (caller-set headers win)."""
     out = dict(headers or {})
     hv = _trace.inject_header()
     if hv is not None:
         out.setdefault(_trace.TRACE_HEADER, hv)
+    dv = _deadline.inject_header()
+    if dv is not None:
+        out.setdefault(_deadline.DEADLINE_HEADER, dv)
     out.setdefault(INTERNAL_HEADER, "1")
     return out
 
@@ -175,6 +179,7 @@ async def request(
 ) -> tuple[int, bytes, dict]:
     """Full-body request over the loop's pool → (status, bytes, headers).
     http:// only — callers gate on the scheme and fall back otherwise."""
+    timeout = _deadline.clamp_timeout(timeout)
     u = urllib.parse.urlsplit(url)
     key = (u.hostname, u.port)
     hdrs = _outbound_headers(headers)
@@ -294,6 +299,7 @@ async def stream(
     """Request whose RESPONSE body stays on the wire: (status,
     AStreamBody, headers) on success, (status, error bytes, headers) for
     >= 400 — the async mirror of http_util.http_stream_response."""
+    timeout = _deadline.clamp_timeout(timeout)
     u = urllib.parse.urlsplit(url)
     key = (u.hostname, u.port)
     hdrs = _outbound_headers(headers)

@@ -41,7 +41,9 @@ from ..filer.entry import Entry, FileChunk
 from ..filer.filechunks import MAX_INT64, view_from_chunks
 from ..filer.filer import Filer
 from ..filer.filerstore import NotFoundError, SqliteStore
+from ..util import deadline as _deadline
 from ..util import faultpoints, glog
+from ..util import hedge as _hedge
 from ..util.parsers import tolerant_ufloat, tolerant_uint
 from ..wdclient import MasterClient
 from .http_util import (
@@ -287,6 +289,7 @@ class FilerServer:
         store=None,
         read_window: int = 4,
         write_window: int = 4,
+        ring_peers: Optional[list[str]] = None,
     ):
         from ..stats import default_registry, query_stats
         from ..util.chunk_cache import TieredChunkCache
@@ -375,6 +378,34 @@ class FilerServer:
         self.meta_aggregator = MetaAggregator(
             self.filer, f"{host}:{port}", peers or []
         )
+        # sharded fleet (filer/ring.py): ring_peers is the FULL member
+        # list including this filer. With <2 members the ring is inert and
+        # every path below serves exactly as before — single-filer
+        # clusters never see a redirect, proxy, or fan-out.
+        from ..filer.ring import FilerRing
+
+        self.ring = FilerRing(
+            list(ring_peers or []), self_url=f"{host}:{port}"
+        )
+        # fid-range leases (cluster/fid_lease.py): single-fid assigns mint
+        # locally from a master-granted key range; per-request coalesced
+        # assigns remain the fallback on any lease failure
+        from ..cluster.fid_lease import LeasedFidSource
+
+        sign_fn = None
+        if jwt_signing_key:
+            from ..security import gen_jwt
+
+            sign_fn = lambda fid: gen_jwt(jwt_signing_key, fid)  # noqa: E731
+        self._fid_leases = LeasedFidSource(
+            self._lease_grant, self._assign_coalescer.assign, sign_fn
+        )
+        # chunk-fetch latency: its p99 is the hedge trigger delay (util/
+        # hedge.py pick_delay_s) — hedging self-tunes to observed tails
+        self._chunk_hist = self.metrics.histogram(
+            "filer_chunk_fetch_seconds",
+            "filer→volume chunk fetch latency (hedge delay source)",
+        )
 
     @property
     def master_url(self) -> str:
@@ -416,6 +447,285 @@ class FilerServer:
         if any(p == self._conf_path for p in paths):
             self._load_filer_conf()
 
+    def _lease_grant(self, collection: str, replication: str, ttl: str,
+                     count: int) -> dict:
+        """The fid-lease RPC: one master round trip reserves ``count``
+        needle keys this filer mints from locally."""
+        qs = urllib.parse.urlencode({
+            "client": self.url, "count": str(count),
+            "collection": collection, "replication": replication,
+            "ttl": ttl,
+        })
+        return http_json(
+            "POST", f"http://{self.master_url}/dir/fid_lease?{qs}"
+        )
+
+    def _assign_one(self, collection: str, replication: str, ttl: str):
+        """Single-fid source for the write path: leased local minting
+        first (zero master round-trips while a range is live), coalesced
+        per-request assigns as the always-correct fallback."""
+        return self._fid_leases.assign(collection, replication, ttl)
+
+    # -- sharded fleet: ownership gates (filer/ring.py) ----------------------
+    # With <2 ring members every gate below returns None immediately and
+    # the daemon behaves exactly as the single-filer build. With a fleet:
+    # reads REDIRECT foreign paths (307 — bodies can be huge and a GET is
+    # safe to re-issue), writes PROXY (a consumed stream can't replay a
+    # 307), and spine dirs — shallower than the shard key — fan out so
+    # `ls /bucket` stays correct with children living on every member.
+    # ``noRedirect=1`` marks intra-fleet hops and breaks forwarding loops.
+
+    @staticmethod
+    def _fwd_query(q) -> str:
+        qs = urllib.parse.urlencode({**q, "noRedirect": "1"})
+        return f"?{qs}" if qs else ""
+
+    def _redirect_to_owner(self, h, path, q, owner: str):
+        h.extra_headers = {
+            "Location": f"http://{owner}{path}{self._fwd_query(q)}"
+        }
+        return 307, {"redirect": owner}
+
+    def _ring_point_gate(self, h, path, q):
+        """Ownership gate for point lookups (GET/HEAD of one path):
+        None → serve locally, else a 307 to the owner. Spine paths are
+        served by every member; a locally-missing one still redirects to
+        its owner in case it's a file AT spine depth (owner-placed)."""
+        if not self.ring.active or q.get("noRedirect") == "1":
+            return None
+        p = urllib.parse.unquote(path).rstrip("/") or "/"
+        if self.ring.is_spine(p):
+            try:
+                self.filer.find_entry(p)
+                return None  # the local replica answers
+            except NotFoundError:
+                pass
+        owner = self.ring.owner(p)
+        if owner != self.ring.self_url:
+            return self._redirect_to_owner(h, path, q, owner)
+        return None
+
+    def _ring_read_gate(self, h, path, q):
+        g = self._ring_point_gate(h, path, q)
+        if g is not None:
+            return g
+        if not self.ring.active or q.get("noRedirect") == "1":
+            return None
+        p = urllib.parse.unquote(path).rstrip("/") or "/"
+        if not self.ring.is_spine(p):
+            return None
+        try:
+            entry = self.filer.find_entry(p)
+        except NotFoundError:
+            return None
+        if entry.is_directory and not (
+            q.get("meta") == "true" and not path.endswith("/")
+        ):
+            return self._spine_list_merged(h, path, q, p)
+        return None
+
+    def _spine_list_merged(self, h, path, q, p):
+        """Spine dir listing: shard roots live on their owners and deeper
+        spine dirs on everyone, so the children of a spine dir are spread
+        across the fleet — fan out with noRedirect, merge by name (prefer
+        the directory copy), present one sorted view. Mirrors
+        RingFilerClient.list so dumb and smart clients see identical
+        listings."""
+        limit = self._qint(q, "limit", 1000)
+        merged: dict[str, dict] = {}
+
+        def fold(entries):
+            for e in entries:
+                name = e.get("name", "")
+                prev = merged.get(name)
+                if prev is None or (
+                    not prev.get("is_directory") and e.get("is_directory")
+                ):
+                    merged[name] = e
+
+        status, local = self._h_read_inner(
+            h, path, dict(q, noRedirect="1"), b""
+        )
+        if status == 200 and isinstance(local, dict):
+            fold(local.get("entries", []))
+        target = path if path.endswith("/") else path + "/"
+        qs = self._fwd_query(q)
+        for m in self.ring.members():
+            if m == self.ring.self_url:
+                continue
+            try:
+                r = http_json("GET", f"http://{m}{target}{qs}")
+                fold(r.get("entries", []))
+            except Exception:  # sweedlint: ok broad-except a down peer degrades the merged view, never 500s it
+                pass
+        entries = [merged[k] for k in sorted(merged)][:limit]
+        return 200, {
+            "path": p,
+            "entries": entries,
+            "lastFileName": entries[-1]["name"] if entries else "",
+        }
+
+    def _ring_write_gate(self, h, path, q, rfile, length):
+        """Writes PROXY to the owner — the request body is a consumed
+        stream, which a 307 cannot replay. Spine dir creates replicate to
+        every member; cross-shard renames are refused here (the ring-aware
+        client decomposes them into copy + metadata-only delete)."""
+        if not self.ring.active or q.get("noRedirect") == "1":
+            return None
+        parsed = urllib.parse.unquote(path)
+        p = parsed.rstrip("/") or "/"
+        if q.get("mv.to"):
+            if self.ring.owner(q["mv.to"].rstrip("/") or "/") != self.ring.owner(p):
+                return 400, {
+                    "error": "cross-shard rename: use a ring-aware client"
+                }
+            if self.ring.owner(p) != self.ring.self_url:
+                return self._proxy_write(
+                    h, path, q, self.ring.owner(p), rfile, length
+                )
+            return None
+        if self.ring.is_spine(p):
+            from .http_util import http_bytes
+
+            ct = h.headers.get("Content-Type", "") or ""
+            body = None
+            is_dir = parsed.endswith("/")
+            if q.get("meta") == "true":
+                # a meta=true entry at spine depth may be a FILE (owner-
+                # placed) — sniff the small body to tell; every branch
+                # that consumed it finishes from the buffer
+                body = rfile.read(length) if length else b""
+                try:
+                    is_dir = bool(json.loads(body).get("is_directory"))
+                except (ValueError, AttributeError):
+                    is_dir = parsed.endswith("/")
+            if is_dir:
+                # spine DIR create (mkdir / meta entry): every member
+                # holds a replica so its listings can fan out from it
+                if body is None:
+                    body = rfile.read(length) if length else b""
+                st, payload = self._h_write_inner(
+                    h, path, dict(q, noRedirect="1"), body
+                )
+                qs = self._fwd_query(q)
+                for m in self.ring.members():
+                    if m == self.ring.self_url:
+                        continue
+                    try:
+                        http_bytes(
+                            h.command, f"http://{m}{path}{qs}", body=body,
+                            headers={"Content-Type": ct} if ct else None,
+                        )
+                    except OSError:
+                        # a joining/down member backfills via reshard; the
+                        # merged listing hides the gap meanwhile
+                        pass
+                return st, payload
+            if body is not None:
+                # buffered meta=true FILE entry at spine depth
+                owner = self.ring.owner(p)
+                if owner == self.ring.self_url:
+                    return self._h_write_inner(
+                        h, path, dict(q, noRedirect="1"), body
+                    )
+                url = f"http://{owner}{path}{self._fwd_query(q)}"
+                try:
+                    st, data = http_bytes(
+                        h.command, url, body=body,
+                        headers={"Content-Type": ct} if ct else None,
+                    )
+                except OSError as e:
+                    return 502, {"error": f"owner {owner} unreachable: {e}"}
+                h.extra_headers = {"Content-Type": "application/json"}
+                return st, data
+            # plain FILE stream at spine depth: owner-placed, body untouched
+        owner = self.ring.owner(p)
+        if owner != self.ring.self_url:
+            return self._proxy_write(h, path, q, owner, rfile, length)
+        return None
+
+    def _proxy_write(self, h, path, q, owner, rfile, length):
+        from .http_util import CountedReader, http_stream_request
+
+        fwd = {
+            k: v for k, v in h.headers.items()
+            if k.lower() == "content-type"
+            or k.title().startswith("Seaweed-")
+        }
+        url = f"http://{owner}{path}{self._fwd_query(q)}"
+        try:
+            status, data, _ = http_stream_request(
+                h.command, url, CountedReader(rfile, length), length,
+                headers=fwd,
+            )
+        except OSError as e:
+            return 502, {"error": f"owner {owner} unreachable: {e}"}
+        h.extra_headers = {"Content-Type": "application/json"}
+        return status, data
+
+    def _ring_delete_gate(self, h, path, q):
+        if not self.ring.active or q.get("noRedirect") == "1":
+            return None
+        p = urllib.parse.unquote(path).rstrip("/") or "/"
+        if self.ring.is_spine(p):
+            local_is_dir = False
+            try:
+                local_is_dir = self.filer.find_entry(p).is_directory
+            except NotFoundError:
+                pass
+            if local_is_dir:
+                return self._spine_delete_fanout(h, path, q)
+        owner = self.ring.owner(p)
+        if owner != self.ring.self_url:
+            return self._proxy_delete(h, path, q, owner)
+        return None
+
+    def _spine_delete_fanout(self, h, path, q):
+        """Delete a replicated spine dir on every member. Success wants
+        RingFilerClient.delete's shape: worst non-404 status wins; a 404
+        only surfaces when NOBODY had the entry."""
+        from .http_util import http_bytes
+
+        p = urllib.parse.unquote(path).rstrip("/") or "/"
+        qs = self._fwd_query(q)
+        worst, purged = 0, 0
+        for m in self.ring.members():
+            if m == self.ring.self_url:
+                st, payload = self._h_delete_inner(
+                    h, path, dict(q, noRedirect="1"), b""
+                )
+            else:
+                try:
+                    st, raw = http_bytes("DELETE", f"http://{m}{path}{qs}")
+                    try:
+                        payload = json.loads(raw)
+                    except ValueError:
+                        payload = {}
+                except OSError:
+                    st, payload = 502, {}
+            if st == 404:
+                continue
+            if st < 400 and isinstance(payload, dict):
+                purged += payload.get("purged_chunks", 0)
+            worst = max(worst, st)
+        if worst == 0:
+            return 404, {"error": f"{p} not found"}
+        if worst < 400:
+            return 200, {"purged_chunks": purged}
+        return worst, {"error": "spine delete partially failed"}
+
+    def _proxy_delete(self, h, path, q, owner):
+        from .http_util import http_bytes
+
+        try:
+            status, data = http_bytes(
+                "DELETE", f"http://{owner}{path}{self._fwd_query(q)}"
+            )
+        except OSError as e:
+            return 502, {"error": f"owner {owner} unreachable: {e}"}
+        h.extra_headers = {"Content-Type": "application/json"}
+        return status, data
+
     def _purge_chunks(self, fids: list[str]) -> None:
         t = threading.Thread(
             target=operation.delete_files,
@@ -441,8 +751,8 @@ class FilerServer:
         count = self._qint(q, "count", 1)
         try:
             if count <= 1:
-                # single-fid asks ride the coalescer with the write path
-                a = self._assign_coalescer.assign(
+                # single-fid asks ride the lease/coalescer with the write path
+                a = self._assign_one(
                     q.get("collection", self.collection),
                     q.get("replication", self.replication),
                     q.get("ttl", ""),
@@ -523,6 +833,37 @@ class FilerServer:
 
         return sync_stats()
 
+    def _h_ring(self, h, path, q, body):
+        """Shard layout + the tail/scale counters an operator reads when
+        debugging the fleet: ring placement, hedge outcomes, fid-lease
+        minting, deadline aborts."""
+        return 200, {
+            "ring": self.ring.plan(),
+            "hedge": _hedge.STATS.snapshot(),
+            "fid_leases": self._fid_leases.stats(),
+            "deadline": _deadline.counts(),
+        }
+
+    def _h_reshard(self, h, path, q, body):
+        """Drive a subtree handoff FROM this filer to ``target``: the
+        marker-guarded, checkpointed copy in filer/reshard.py. Idempotent
+        — kill this daemon mid-run and a re-POST with the same epoch
+        resumes from the durable prefix and converges."""
+        from ..filer.reshard import Resharder
+
+        root = q.get("root", "")
+        target = q.get("target", "")
+        if not root or not target:
+            return 400, {"error": "root and target are required"}
+        try:
+            summary = Resharder(
+                self.url, target, root, q.get("epoch", "0"),
+                ckpt_every=self._qint(q, "ckpt_every", 32),
+            ).run()
+        except Exception as e:  # reshard is re-POSTable; the error is the operator's signal
+            return 500, {"error": str(e)}
+        return 200, summary
+
     def _h_status(self, h, path, q, body):
         return 200, {
             "signature": self.signature,
@@ -556,6 +897,11 @@ class FilerServer:
                 "read": self._req_hist.summary(op="read"),
                 "read_stream": self._req_hist.summary(op="read_stream"),
             },
+            # metadata fleet: shard layout + hedge/lease/deadline counters
+            "ring": self.ring.plan(),
+            "hedge": _hedge.STATS.snapshot(),
+            "fid_leases": self._fid_leases.stats(),
+            "deadline": _deadline.counts(),
             "trace": _trace.trace_stats(),
         }
 
@@ -665,6 +1011,9 @@ class FilerServer:
 
             drain_refused_body(h, CountedReader(rfile, length))
             return 400, {"error": "dot path segments not allowed"}
+        g = self._ring_write_gate(h, path, q, rfile, length)
+        if g is not None:
+            return g
         meta_shaped = (
             q.get("mv.to") or q.get("link.to") or q.get("meta") == "true"
             or parsed_path.endswith("/")
@@ -792,7 +1141,7 @@ class FilerServer:
         a = (
             assigner()
             if assigner is not None
-            else self._assign_coalescer.assign(collection, replication, ttl)
+            else self._assign_one(collection, replication, ttl)
         )
         if record is not None:
             # record BEFORE uploading: a piece that fails (or crashes) mid-
@@ -852,6 +1201,9 @@ class FilerServer:
 
     # -- read path ------------------------------------------------------------
     def _h_read(self, h, path, q, body):
+        g = self._ring_read_gate(h, path, q)
+        if g is not None:
+            return g
         with self._req_hist.time(op="read"):
             return self._h_read_inner(h, path, q, body)
 
@@ -936,7 +1288,7 @@ class FilerServer:
         use_cipher: bool,
     ) -> FileChunk:
         """Assign + upload one blob; used for manifest chunks."""
-        a = self._assign_coalescer.assign(collection, replication, ttl)
+        a = self._assign_one(collection, replication, ttl)
         cipher_key_b64 = ""
         payload = blob
         if use_cipher:
@@ -955,7 +1307,13 @@ class FilerServer:
         )
 
     def _fetch_chunk(self, file_id: str) -> bytes:
-        """One stored chunk's raw (possibly encrypted) bytes, cache-aside."""
+        """One stored chunk's raw (possibly encrypted) bytes, cache-aside.
+
+        Tail-at-scale: with a second replica available, a hedge leg fires
+        against it after a delay derived from this histogram's own live
+        p99 (util/hedge.py) — only the slowest ~1% of fetches race two
+        copies, the budget gate bounds the extra backend load, and a
+        FAILED primary fails over immediately regardless of budget."""
         from ..storage.file_id import FileId
         from .http_util import http_bytes
 
@@ -967,13 +1325,45 @@ class FilerServer:
         from ..security import read_auth_query
 
         auth = read_auth_query(self.jwt_read_key, file_id)
-        for loc in locs:
-            status, body = http_bytes(
-                "GET", f"http://{loc['url']}/{file_id}{auth}"
-            )
-            if status == 200:
-                data = body
-                break
+
+        def leg(url):
+            def call():
+                status, body = http_bytes(
+                    "GET", f"http://{url}/{file_id}{auth}"
+                )
+                if status != 200:
+                    raise ConnectionError(
+                        f"chunk {file_id}@{url}: HTTP {status}"
+                    )
+                return body
+            return call
+
+        if locs:
+            hedge_leg = leg(locs[1]["url"]) if len(locs) > 1 else None
+            delay = _hedge.pick_delay_s(self._chunk_hist.quantile(0.99))
+            try:
+                with self._chunk_hist.time():
+                    data, winner = _hedge.hedged_call(
+                        leg(locs[0]["url"]), hedge_leg, delay
+                    )
+                if winner == "hedge":
+                    span = _trace.current_span()
+                    if span is not None:
+                        # trace exemplars prove which replica answered
+                        span.tags["hedge"] = "won"
+            except Exception:  # remaining replicas + master re-lookup still serve
+                data = None
+            if data is None:
+                for loc in locs[2:]:
+                    try:
+                        status, body = http_bytes(
+                            "GET", f"http://{loc['url']}/{file_id}{auth}"
+                        )
+                    except OSError:
+                        continue
+                    if status == 200:
+                        data = body
+                        break
         if data is None:
             self._lookup.invalidate(fid.volume_id)
             data = operation.download(
@@ -1093,10 +1483,13 @@ class FilerServer:
 
         return timed()
 
-    async def _afetch_chunk(self, file_id: str, url: str) -> bytes:
-        """Async mirror of _fetch_chunk for the native read path: one
-        volume url (the caller resolved it from the cached vid map), the
-        loop's pooled keep-alive transport, cache-aside ciphertext."""
+    async def _afetch_chunk(self, file_id: str, url: str,
+                            hedge_url: Optional[str] = None) -> bytes:
+        """Async mirror of _fetch_chunk for the native read path: volume
+        urls resolved by the caller from the cached vid map, the loop's
+        pooled keep-alive transport, cache-aside ciphertext. With a
+        second replica url the same p99-triggered hedge race runs here —
+        natively, so the losing task gets a real cancel()."""
         data = self.chunk_cache.get(file_id)
         if data is not None:
             return data
@@ -1104,16 +1497,37 @@ class FilerServer:
         from . import aio_transport
 
         auth = read_auth_query(self.jwt_read_key, file_id)
-        status, body, _ = await aio_transport.request(
-            "GET", f"http://{url}/{file_id}{auth}"
-        )
-        if status != 200:
-            raise ConnectionError(f"chunk {file_id}: HTTP {status}")
+
+        async def leg(u: str) -> bytes:
+            status, body, _ = await aio_transport.request(
+                "GET", f"http://{u}/{file_id}{auth}"
+            )
+            if status != 200:
+                raise ConnectionError(f"chunk {file_id}@{u}: HTTP {status}")
+            return body
+
+        t0 = time.perf_counter()
+        try:
+            if hedge_url is None:
+                body = await leg(url)
+            else:
+                delay = _hedge.pick_delay_s(
+                    self._chunk_hist.quantile(0.99)
+                )
+                body, winner = await _hedge.ahedged_call(
+                    lambda: leg(url), lambda: leg(hedge_url), delay
+                )
+                if winner == "hedge":
+                    span = _trace.current_span()
+                    if span is not None:
+                        span.tags["hedge"] = "won"
+        finally:
+            self._chunk_hist.observe(time.perf_counter() - t0)
         self.chunk_cache.put(file_id, body)
         return body
 
     async def _astream_range(self, views, urls: dict, offset: int,
-                             size: int):
+                             size: int, alts: Optional[dict] = None):
         """Async generator of body pieces for [offset, offset+size) —
         the native mirror of _stream_range's produce(): aprefetch_iter
         drives up to ``read_window`` chunk fetches concurrently ON the
@@ -1131,9 +1545,12 @@ class FilerServer:
         pos = offset
         memo: OrderedDict[str, bytes] = OrderedDict()
         t0 = time.perf_counter()
+        hedge_urls = alts or {}
         fetched = aprefetch_iter(
             views,
-            lambda v: self._afetch_chunk(v.file_id, urls[v.file_id]),
+            lambda v: self._afetch_chunk(
+                v.file_id, urls[v.file_id], hedge_urls.get(v.file_id)
+            ),
             window,
             key=lambda v: v.file_id,  # single-flight per fid
         )
@@ -1194,10 +1611,18 @@ class FilerServer:
             return NATIVE_FALLBACK
         t0 = time.perf_counter()
         lookup = urllib.parse.unquote(path).rstrip("/") or "/"
+        if (
+            self.ring.active
+            and q.get("noRedirect") != "1"
+            and not self.ring.owns(lookup)
+        ):
+            return NATIVE_FALLBACK  # bridged ring gate renders the 307
         try:
             entry = self.filer.find_entry(lookup)
         except NotFoundError:
-            return NATIVE_FALLBACK  # bridge renders the canonical 404
+            # bridge renders the canonical 404 — or, for a spine-depth
+            # file whose owner is a peer, the ring gate's redirect
+            return NATIVE_FALLBACK
         if entry.is_directory:
             return NATIVE_FALLBACK
         from ..filer.filechunk_manifest import has_chunk_manifest
@@ -1221,27 +1646,31 @@ class FilerServer:
 
         vid_map = self._master_client.vid_map
         urls: dict[str, str] = {}
+        alts: dict[str, str] = {}  # second replica per fid → hedge leg
         for v in views:
             if v.file_id in urls:
                 continue
-            url = vid_map.lookup_volume_url(
+            locs = vid_map.lookup_volume(
                 FileId.parse(v.file_id).volume_id
             )
-            if url is None:
+            if not locs:
                 return NATIVE_FALLBACK
-            urls[v.file_id] = url
+            urls[v.file_id] = locs[0].url
+            if len(locs) > 1:
+                alts[v.file_id] = locs[1].url
         if views:
             # eager first chunk, like _stream_range's eager first piece:
             # a down volume surfaces as a bridged 500, not a truncated
             # native 200 (and the fetch lands in chunk_cache either way)
             try:
                 await self._afetch_chunk(
-                    views[0].file_id, urls[views[0].file_id]
+                    views[0].file_id, urls[views[0].file_id],
+                    alts.get(views[0].file_id),
                 )
             except Exception:  # noqa: BLE001 — bridge retries all replicas
                 return NATIVE_FALLBACK
         body = AsyncStreamBody(
-            size, self._astream_range(views, urls, offset, size)
+            size, self._astream_range(views, urls, offset, size, alts)
         )
         self._req_hist.observe(time.perf_counter() - t0, op="read")
         if parsed is not None:
@@ -1281,6 +1710,9 @@ class FilerServer:
         return bytes(out)
 
     def _h_head(self, h, path, q, body):
+        g = self._ring_point_gate(h, path, q)
+        if g is not None:
+            return g
         path = urllib.parse.unquote(path).rstrip("/") or "/"
         try:
             entry = self.filer.find_entry(path)
@@ -1290,6 +1722,12 @@ class FilerServer:
 
     # -- delete ----------------------------------------------------------------
     def _h_delete(self, h, path, q, body):
+        g = self._ring_delete_gate(h, path, q)
+        if g is not None:
+            return g
+        return self._h_delete_inner(h, path, q, body)
+
+    def _h_delete_inner(self, h, path, q, body):
         path = urllib.parse.unquote(path).rstrip("/") or "/"
         try:
             fids = self.filer.delete_entry(
@@ -1321,6 +1759,8 @@ class FilerServer:
                 # _-prefixed like the other filer-internal routes: a bare
                 # /ui would shadow user files stored under that prefix
                 ("GET", "/_ui", fs._h_ui),
+                ("GET", "/_ring", fs._h_ring),
+                ("POST", "/_reshard", fs._h_reshard),
                 ("GET", "/_status", fs._h_status),
                 ("GET", "/metrics", fs._h_metrics),
                 ("POST", "/_query", fs._h_query),

@@ -25,6 +25,7 @@ from seaweedfs_tpu.util.throttler import (
 )
 
 from ..stats import trace as _trace
+from ..util import deadline as _deadline
 
 # Flipped by start_server(): a process that serves cluster traffic marks
 # its OUTBOUND pooled-transport requests with X-Sweed-Internal, so
@@ -47,13 +48,18 @@ def _trace_headers(headers: Optional[dict]) -> Optional[dict]:
     ``X-Sweed-Trace: <trace_id>:<span_id>`` so the receiving daemon's
     server span joins the caller's tree; daemon processes additionally
     stamp ``X-Sweed-Internal`` (tenant-governor bypass). The original
-    dict is never mutated; explicit caller-set headers win."""
+    dict is never mutated; explicit caller-set headers win. The ambient
+    deadline rides the same choke point (``X-Sweed-Deadline``), so every
+    internal hop a traced request takes also carries its budget."""
     hv = _trace.inject_header()
-    if hv is None and not _cluster_process:
+    dv = _deadline.inject_header()
+    if hv is None and dv is None and not _cluster_process:
         return headers
     out = dict(headers or {})
     if hv is not None:
         out.setdefault(_trace.TRACE_HEADER, hv)
+    if dv is not None:
+        out.setdefault(_deadline.DEADLINE_HEADER, dv)
     if _cluster_process:
         out.setdefault(INTERNAL_HEADER, "1")
     return out
@@ -540,6 +546,13 @@ class JsonHandler(BaseHTTPRequestHandler):
         elif GOVERNOR.enabled() and tenant != INTERNAL_TENANT:
             count_qos_decision(tenant, "ok")
         t0 = time.monotonic()
+        # ambient deadline: parsed once, entered around the handler so
+        # every downstream hop this request makes inherits the budget
+        # (the transports clamp + refuse on it). Runs in BOTH cores —
+        # the aio reactor bridges through this same dispatch.
+        ddl = (_deadline.parse_header(
+            self.headers.get(_deadline.DEADLINE_HEADER))
+            if _deadline.enabled() else None)
         body = None  # read lazily: streaming handlers consume rfile directly
         for m, prefix, fn in self.routes:
             if m == method and parsed.path.startswith(prefix):
@@ -556,17 +569,37 @@ class JsonHandler(BaseHTTPRequestHandler):
                     parent_header=self.headers.get(_trace.TRACE_HEADER),
                     path=parsed.path,
                 ) as span:
+                    cancelled = False
                     try:
-                        if streaming:
-                            status, payload = fn(
-                                self, parsed.path, query, self.rfile, length
-                            )
-                        else:
-                            if body is None:
-                                body = (self.rfile.read(length)
-                                        if length else b"")
-                            status, payload = fn(self, parsed.path, query,
-                                                 body)
+                        with _deadline.scope(ddl):
+                            if ddl is not None and _deadline.expired():
+                                # budget died upstream of the handler:
+                                # answer 504 without doing the work. The
+                                # unread body breaks keep-alive framing,
+                                # so the connection drops after reply.
+                                _deadline.note("expired_inbound")
+                                cancelled = True
+                                raise _deadline.DeadlineExceeded(
+                                    -(_deadline.remaining() or 0.0))
+                            if streaming:
+                                status, payload = fn(
+                                    self, parsed.path, query, self.rfile,
+                                    length
+                                )
+                            else:
+                                if body is None:
+                                    body = (self.rfile.read(length)
+                                            if length else b"")
+                                status, payload = fn(self, parsed.path,
+                                                     query, body)
+                    except _deadline.DeadlineExceeded as e:
+                        if not cancelled:
+                            _deadline.note("aborted_handler")
+                        cancelled = True
+                        status, payload = 504, {
+                            "error": f"deadline exceeded: {e}"
+                        }
+                        self.close_connection = True
                     except BadRequest as e:
                         status, payload = 400, {"error": str(e)}
                         if streaming:
@@ -586,7 +619,11 @@ class JsonHandler(BaseHTTPRequestHandler):
                             self.close_connection = True
                     if span is not None:
                         span.tags["status"] = status
-                        if status >= 500:
+                        if cancelled:
+                            # the trace tree shows WHERE the budget died
+                            span.status = "cancelled"
+                            span.tags["deadline"] = "exceeded"
+                        elif status >= 500:
                             span.status = "error"
                         if self.extra_headers is None:
                             self.extra_headers = {
@@ -1131,6 +1168,7 @@ def http_stream_request(
     A consumed reader cannot be rewound, so there is NO stale-socket
     retry — instead the pooled socket is liveness-probed before the first
     byte goes out (the common stale case: peer restarted while idle)."""
+    timeout = _deadline.clamp_timeout(timeout)
     hdrs = dict(_trace_headers(headers) or {})
     hdrs.setdefault("Content-Length", str(length))
     if not url.startswith("http://"):
@@ -1226,6 +1264,7 @@ def http_stream_response(
     checked out of the pool until the body is fully read, so a nested
     request to the same peer on this thread gets its own socket);
     anything else falls back to urllib."""
+    timeout = _deadline.clamp_timeout(timeout)
     headers = _trace_headers(headers)
     if not url.startswith("http://"):
         req = urllib.request.Request(url, method=method, headers=headers or {})
@@ -1337,6 +1376,7 @@ def http_bytes_headers(
     endpoints carry metadata such as X-Compaction-Revision there).
     ``idempotent`` opts a POST into the stale-socket one-shot retry
     (fid-addressed uploads are safe to re-send; assigns are not)."""
+    timeout = _deadline.clamp_timeout(timeout)
     headers = _trace_headers(headers)
     if url.startswith("http://"):
         return _pooled_request(method, url, body, headers, timeout,

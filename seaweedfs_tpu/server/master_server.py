@@ -106,6 +106,22 @@ class MasterServer:
             on_volume_id_checkpoint=self.master.topo.checkpoint_max_volume_id,
             state_path=state_path,
         )
+        # Fid-range leases (cluster/fid_lease.py): assign storms from a
+        # filer FLEET scale by granting each filer a key range to mint
+        # locally instead of serializing on /dir/assign. The grant journal
+        # replays into the sequencer before it can issue anything, so a
+        # crashed master never re-issues a leased key (the election-beat
+        # seq_margin above covers failover BETWEEN masters; the journal
+        # covers restart of THIS one even without peers).
+        from ..cluster.fid_lease import FidLeaseManager
+
+        lease_journal = None
+        if meta_dir:
+            import os as _os
+
+            lease_journal = _os.path.join(meta_dir, f"fid_leases_{port}.jsonl")
+        self.fid_leases = FidLeaseManager(lease_journal)
+        self.fid_leases.replay(self.master.sequencer.set_max)
         # lifecycle autopilot (cluster/lifecycle.py): leader-only
         # observe→plan→execute over the heat-annotated topology. Always
         # constructed (so /lifecycle/status answers and recovery state is
@@ -192,6 +208,55 @@ class MasterServer:
         }
         if self.jwt_signing_key:
             # fid-scoped write token (security/jwt.go GenJwt via dirAssign)
+            from ..security import gen_jwt
+
+            out["auth"] = gen_jwt(
+                self.jwt_signing_key, res.fid, self.jwt_expires_seconds
+            )
+        return 200, out
+
+    def _h_fid_lease(self, h, path, q, body):
+        """POST /dir/fid_lease?client=<filer>&count=N — grant a needle-key
+        range the filer mints fids from locally; ?renew=<lease_id>
+        extends a live lease instead. The range is reserved through the
+        normal assign path (volume pick + sequencer bump) and journaled
+        durably BEFORE this response leaves (crash-safe: a restarted
+        master replays grants into the sequencer, so no fid double-
+        issues). Leader-only, like /dir/assign."""
+        renew_id = q.get("renew", "")
+        if renew_id:
+            exp = self.fid_leases.renew(renew_id)
+            if exp is None:
+                return 404, {"error": f"unknown or expired lease {renew_id}"}
+            return 200, {"lease_id": renew_id, "expires": exp}
+        count = tolerant_uint(q.get("count", 128), 128)
+        count = max(1, min(count, 1 << 16))
+        with self._assign_hist.time(op="lease"):
+            res = self.master.assign(
+                count=count,
+                replication=q.get("replication", ""),
+                collection=q.get("collection", ""),
+                ttl=q.get("ttl", ""),
+                data_center=q.get("dataCenter", ""),
+            )
+        from ..storage.file_id import FileId
+
+        base = FileId.parse(res.fid)
+        reg = self.fid_leases.register(
+            q.get("client", h.client_address[0]),
+            base.volume_id, base.key, count,
+        )
+        out = {
+            "fid": res.fid,
+            "url": res.url,
+            "publicUrl": res.public_url,
+            "count": count,
+            "lease_id": reg["lease_id"],
+            "expires": reg["expires"],
+        }
+        if self.jwt_signing_key:
+            # token covers the BASE fid only; the filer self-signs minted
+            # fids with its own key (or refuses the lease without one)
             from ..security import gen_jwt
 
             out["auth"] = gen_jwt(
@@ -305,6 +370,8 @@ class MasterServer:
             "fleet": self.fleet.stats(),
             # assign latency quantiles from the cumulative-bucket histogram
             "assign": self._assign_hist.summary(op="assign"),
+            # fid-range leases: live/granted/replayed (scale-out assigns)
+            "fid_leases": self.fid_leases.stats(),
             "trace": trace.trace_stats(),
             # lifecycle autopilot: cycle counters, interlock state, recovery
             "lifecycle": {
@@ -454,6 +521,9 @@ class MasterServer:
     def _reap_loop(self):
         while not self._stop.wait(self.node_timeout / 3):
             now = time.time()
+            # expired fid leases drop from the live table (their ranges
+            # stay burned in the journal — bookkeeping, not reclamation)
+            self.fid_leases.expire_stale()
             with self._lock:
                 for url, dn in list(self._nodes.items()):
                     # scale to the node's own reported pulse so a long
@@ -482,6 +552,8 @@ class MasterServer:
                 ("POST", "/vol/vacuum", ms._leader_only(ms._h_vacuum)),
                 ("GET", "/vol/vacuum", ms._leader_only(ms._h_vacuum)),
                 ("POST", "/col/delete", ms._leader_only(ms._h_col_delete)),
+                ("POST", "/dir/fid_lease", ms._leader_only(ms._h_fid_lease)),
+                ("GET", "/dir/fid_lease", ms._leader_only(ms._h_fid_lease)),
                 ("POST", "/cluster/lock", ms._leader_only(ms._h_lock)),
                 ("POST", "/cluster/unlock", ms._leader_only(ms._h_unlock)),
                 # fleet EC scheduling: only the leader's topology knows the
@@ -532,6 +604,7 @@ class MasterServer:
         self.election.stop()
         self.lifecycle.stop()
         self.fleet.stop()
+        self.fid_leases.close()
         if self._srv:
             self._srv.shutdown()
             self._srv.server_close()

@@ -262,6 +262,70 @@ def register_qos_metrics(registry: Optional[Registry] = None) -> dict:
 QOS_INSTRUMENTS = register_qos_metrics()
 
 
+def register_hedge_deadline_metrics(
+        registry: Optional[Registry] = None) -> None:
+    """Hedged-read and cross-daemon-deadline evidence (util/hedge.py,
+    util/deadline.py): the zipf-storm acceptance bar ("hedges cut p99 at
+    <5% extra load; expired deadlines abort downstream work") is asserted
+    from these counters, and the OBSERVABILITY.md runbook alerts on
+    skipped_budget and refused_dial."""
+
+    def _hedge(key):
+        from ..util.hedge import STATS
+
+        return STATS.snapshot().get(key, 0)
+
+    def _ddl(key):
+        from ..util import deadline
+
+        return deadline.counts().get(key, 0)
+
+    reg = registry if registry is not None else default_registry
+    reg.gauge(
+        "sweed_hedge_tracked_total",
+        "replica reads that armed a hedge timer",
+    ).set_function(lambda: _hedge("tracked"))
+    reg.gauge(
+        "sweed_hedge_fired_total",
+        "hedge legs actually launched after the p99-derived delay",
+    ).set_function(lambda: _hedge("fired"))
+    reg.gauge(
+        "sweed_hedge_wins_primary_total",
+        "hedged reads where the primary leg answered first",
+    ).set_function(lambda: _hedge("wins_primary"))
+    reg.gauge(
+        "sweed_hedge_wins_hedge_total",
+        "hedged reads where the hedge leg answered first",
+    ).set_function(lambda: _hedge("wins_hedge"))
+    reg.gauge(
+        "sweed_hedge_cancelled_total",
+        "loser legs cancelled after the race was decided",
+    ).set_function(lambda: _hedge("cancelled"))
+    reg.gauge(
+        "sweed_hedge_skipped_budget_total",
+        "hedges suppressed by the extra-load budget gate",
+    ).set_function(lambda: _hedge("skipped_budget"))
+    reg.gauge(
+        "sweed_deadline_clamped_total",
+        "hop timeouts shortened to the remaining cross-daemon budget",
+    ).set_function(lambda: _ddl("clamped"))
+    reg.gauge(
+        "sweed_deadline_refused_dial_total",
+        "downstream calls refused because the budget was already spent",
+    ).set_function(lambda: _ddl("refused_dial"))
+    reg.gauge(
+        "sweed_deadline_expired_inbound_total",
+        "requests answered 504 on arrival: the deadline died upstream",
+    ).set_function(lambda: _ddl("expired_inbound"))
+    reg.gauge(
+        "sweed_deadline_aborted_handler_total",
+        "handlers aborted mid-work by DeadlineExceeded",
+    ).set_function(lambda: _ddl("aborted_handler"))
+
+
+register_hedge_deadline_metrics()
+
+
 def note_qos_request(tenant: str, seconds: float) -> None:
     """Record one request's service time under its tenant label."""
     QOS_INSTRUMENTS["hist"].observe(seconds, tenant=tenant)

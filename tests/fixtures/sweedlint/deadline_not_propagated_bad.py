@@ -1,0 +1,10 @@
+"""Fixture: request handler hops to a peer daemon with raw urlopen —
+deadline-not-propagated must fire exactly once."""
+
+import json
+import urllib.request
+
+
+def fetch_peer_status(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())

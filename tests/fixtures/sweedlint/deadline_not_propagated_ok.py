@@ -1,0 +1,17 @@
+"""Fixture: a deadline-carrying hop via the pooled transport, plus a
+waived third-party egress call — sweedlint must report nothing."""
+
+import urllib.request
+
+from seaweedfs_tpu.server.http_util import http_json
+
+
+def fetch_peer_status(url):
+    # the pooled transport injects X-Sweed-Deadline and clamps timeout
+    return http_json("GET", url)
+
+
+def post_to_cloud_webhook(url):
+    # sweedlint: ok deadline-not-propagated third-party egress; the internal deadline header must not leak outside the cluster
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read()

@@ -1,0 +1,140 @@
+"""ConsistentRing unit tests — the ring is now load-bearing for the
+sharded filer fleet (filer/ring.py), so its corner cases are pinned
+directly instead of only through broker e2e."""
+
+import pytest
+
+from seaweedfs_tpu.messaging import ConsistentRing
+
+
+def test_empty_ring_raises():
+    with pytest.raises(LookupError):
+        ConsistentRing().get("anything")
+
+
+def test_single_member_owns_everything():
+    ring = ConsistentRing()
+    ring.add("only:1")
+    assert all(ring.get(f"k{i}") == "only:1" for i in range(50))
+
+
+def test_len_and_contains():
+    ring = ConsistentRing()
+    assert len(ring) == 0
+    ring.add("a:1")
+    ring.add("b:2")
+    assert len(ring) == 2
+    assert "a:1" in ring and "b:2" in ring and "c:3" not in ring
+
+
+def test_duplicate_add_is_idempotent():
+    ring = ConsistentRing()
+    ring.add("a:1")
+    before = [ring.get(f"k{i}") for i in range(20)]
+    ring.add("a:1")
+    assert len(ring) == 1
+    assert [ring.get(f"k{i}") for i in range(20)] == before
+
+
+def test_remove_unknown_member_is_noop():
+    ring = ConsistentRing()
+    ring.add("a:1")
+    ring.remove("ghost:9")
+    assert ring.members() == ["a:1"]
+
+
+def test_layout_is_order_independent():
+    """Placement is a pure function of the member SET: every daemon and
+    client computes identical ownership no matter the join order."""
+    keys = [f"/bucket/dir{i}" for i in range(200)]
+    a = ConsistentRing()
+    for m in ("f1:1", "f2:2", "f3:3"):
+        a.add(m)
+    b = ConsistentRing()
+    for m in ("f3:3", "f1:1", "f2:2"):
+        b.add(m)
+    assert [a.get(k) for k in keys] == [b.get(k) for k in keys]
+
+
+def test_readd_restores_exact_layout():
+    """A reshard planned against ring A must equal one planned against a
+    reconstructed A (member left and came back)."""
+    keys = [f"/b/{i}" for i in range(200)]
+    ring = ConsistentRing()
+    for m in ("f1:1", "f2:2", "f3:3"):
+        ring.add(m)
+    before = [ring.get(k) for k in keys]
+    ring.remove("f2:2")
+    ring.add("f2:2")
+    assert [ring.get(k) for k in keys] == before
+
+
+def test_remove_only_moves_the_removed_members_keys():
+    keys = [f"/tenant/{i}" for i in range(500)]
+    ring = ConsistentRing()
+    for m in ("f1:1", "f2:2", "f3:3", "f4:4"):
+        ring.add(m)
+    before = {k: ring.get(k) for k in keys}
+    ring.remove("f3:3")
+    for k in keys:
+        owner = ring.get(k)
+        assert owner != "f3:3"
+        if before[k] != "f3:3":
+            # keys not on the removed member stay exactly put
+            assert owner == before[k]
+
+
+def test_distribution_roughly_even():
+    ring = ConsistentRing(replicas=50)
+    members = [f"f{i}:{i}" for i in range(4)]
+    for m in members:
+        ring.add(m)
+    counts = {m: 0 for m in members}
+    n = 4000
+    for i in range(n):
+        counts[ring.get(f"/bucket/prefix{i}")] += 1
+    # consistent hashing is approximate; each member should land within a
+    # loose factor of the fair share
+    fair = n / len(members)
+    for m, c in counts.items():
+        assert 0.3 * fair < c < 2.5 * fair, counts
+
+
+def test_replicas_clamped_to_at_least_one():
+    ring = ConsistentRing(replicas=0)
+    ring.add("a:1")
+    assert ring.get("k") == "a:1"
+
+
+def test_cross_member_virtual_node_collisions_survive():
+    """Two members whose virtual nodes hash identically must both stay
+    addressable, deterministically, and removing one must not disturb
+    the other (the sorted (hash, member) tie-break)."""
+    import seaweedfs_tpu.messaging.consistent as consistent
+
+    orig = consistent._hash
+
+    def colliding(key):
+        # force every virtual node of m1/m2 to the same hash bucket
+        s = key if isinstance(key, str) else key.decode()
+        if s.startswith(("m1#", "m2#")):
+            return 42
+        return orig(key)
+
+    consistent._hash = colliding
+    try:
+        ring = ConsistentRing()
+        ring.add("m1")
+        ring.add("m2")
+        ring.add("m3")
+        owners = {ring.get(f"k{i}") for i in range(300)}
+        assert "m3" in owners  # the uncolliding member still serves
+        first = ring.get("fixed-key")
+        assert all(ring.get("fixed-key") == first for _ in range(10))
+        m3_keys = [f"k{i}" for i in range(300)
+                   if ring.get(f"k{i}") == "m3"]
+        ring.remove("m1")
+        # m2 absorbed m1's range; m3's keys never moved
+        assert all(ring.get(k) == "m3" for k in m3_keys)
+    finally:
+        consistent._hash = orig

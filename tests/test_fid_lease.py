@@ -1,0 +1,253 @@
+"""Fid-range leases (cluster/fid_lease.py): grant/renew/expiry units and
+the crash-replay invariant — across any master restart, no fid is ever
+issued twice."""
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.cluster.fid_lease import (
+    FidLeaseManager,
+    LeasedFidSource,
+    lease_count,
+    lease_seconds,
+)
+
+
+# -- manager units ------------------------------------------------------------
+
+def test_register_returns_lease_and_counts(tmp_path):
+    m = FidLeaseManager(str(tmp_path / "leases.jsonl"))
+    reg = m.register("filer-a", vid=3, key=100, count=64)
+    assert reg["lease_id"] and reg["expires"] > 0
+    st = m.stats()
+    assert st["granted"] == 1 and st["live"] == 1
+    m.close()
+
+
+def test_renew_extends_live_lease(tmp_path):
+    m = FidLeaseManager(str(tmp_path / "leases.jsonl"))
+    reg = m.register("filer-a", vid=1, key=10, count=8, ttl_s=30)
+    exp2 = m.renew(reg["lease_id"], ttl_s=60)
+    assert exp2 is not None and exp2 > reg["expires"]
+    assert m.stats()["renewed"] == 1
+    m.close()
+
+
+def test_renew_unknown_or_expired_returns_none(tmp_path):
+    m = FidLeaseManager(str(tmp_path / "leases.jsonl"))
+    assert m.renew("L999-0") is None
+    reg = m.register("filer-a", vid=1, key=10, count=8, ttl_s=0.001)
+    import time
+
+    time.sleep(0.01)
+    assert m.renew(reg["lease_id"]) is None
+    m.close()
+
+
+def test_expire_stale_drops_from_live_table_only(tmp_path):
+    path = str(tmp_path / "leases.jsonl")
+    m = FidLeaseManager(path)
+    m.register("filer-a", vid=1, key=10, count=8, ttl_s=0.001)
+    m.register("filer-b", vid=1, key=18, count=8, ttl_s=60)
+    import time
+
+    time.sleep(0.01)
+    assert m.expire_stale() == 1
+    st = m.stats()
+    assert st["live"] == 1 and st["expired"] == 1
+    # the expired range stays burned in the journal
+    grants = [json.loads(ln) for ln in open(path) if ln.strip()]
+    assert sum(1 for r in grants if r["op"] == "grant") == 2
+    m.close()
+
+
+def test_journal_is_durable_before_response(tmp_path):
+    """register() returns only after the grant record is on disk — the
+    journal is what makes a restarted master honor ranges in flight."""
+    path = str(tmp_path / "leases.jsonl")
+    m = FidLeaseManager(path)
+    m.register("filer-a", vid=7, key=500, count=128)
+    recs = [json.loads(ln) for ln in open(path) if ln.strip()]
+    assert recs and recs[0]["op"] == "grant"
+    assert recs[0]["key"] == 500 and recs[0]["count"] == 128
+    m.close()
+
+
+def test_no_journal_path_disables_persistence():
+    m = FidLeaseManager(None)
+    reg = m.register("filer-a", vid=1, key=1, count=4)
+    assert reg["lease_id"]
+    assert m.replay(lambda _high: pytest.fail("no journal to replay")) == 0
+    m.close()
+
+
+# -- crash replay: the double-issue invariant ---------------------------------
+
+def test_replay_protects_every_granted_range(tmp_path):
+    path = str(tmp_path / "leases.jsonl")
+    m = FidLeaseManager(path)
+    m.register("filer-a", vid=1, key=100, count=64)
+    m.register("filer-b", vid=2, key=164, count=64)
+    m.register("filer-a", vid=1, key=228, count=16)
+    m.close()
+
+    # "restarted master": fresh manager over the same journal
+    seen = []
+    m2 = FidLeaseManager(path)
+    high = m2.replay(seen.append)
+    assert high == 228 + 16
+    assert seen == [244]
+    assert m2.stats()["replayed_max_key"] == 244
+    m2.close()
+
+
+def test_replay_skips_torn_tail(tmp_path):
+    """A torn last line (crash mid-append) never acked its RPC, so no
+    filer holds that range — replay must skip it, not crash."""
+    path = str(tmp_path / "leases.jsonl")
+    m = FidLeaseManager(path)
+    m.register("filer-a", vid=1, key=100, count=64)
+    m.close()
+    with open(path, "a") as f:
+        f.write('{"op": "grant", "key": 999, "cou')  # torn
+    m2 = FidLeaseManager(path)
+    assert m2.replay(lambda h: None) == 164
+    m2.close()
+
+
+def test_crash_replay_no_fid_double_issued(tmp_path):
+    """End-to-end invariant over a simulated crash/restart cycle: a
+    sequencer restored via replay can never re-issue a key inside any
+    journaled range, even though the in-memory lease table is gone."""
+    path = str(tmp_path / "leases.jsonl")
+
+    class Seq:
+        def __init__(self):
+            self.next_key = 1
+
+        def take(self, n):
+            base = self.next_key
+            self.next_key += n
+            return base
+
+        def set_max(self, high):
+            self.next_key = max(self.next_key, high)
+
+    # incarnation 1: grant three ranges, then "crash" (no close/cleanup)
+    seq1, m1 = Seq(), FidLeaseManager(path)
+    issued = set()
+    for client in ("f1", "f2", "f3"):
+        base = seq1.take(32)
+        m1.register(client, vid=1, key=base, count=32)
+        issued.update(range(base, base + 32))
+
+    # incarnation 2: fresh sequencer, journal replayed before any issue
+    seq2, m2 = Seq(), FidLeaseManager(path)
+    m2.replay(seq2.set_max)
+    base = seq2.take(32)
+    m2.register("f4", vid=1, key=base, count=32)
+    fresh = set(range(base, base + 32))
+    assert not (fresh & issued), "restarted master re-issued leased keys"
+    m2.close()
+
+
+# -- filer-side minting -------------------------------------------------------
+
+def _grant_ok(collection, replication, ttl, count, base_key=100):
+    import time
+
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    return {
+        "fid": str(FileId(3, base_key, 0xABCD)),
+        "url": "127.0.0.1:9000",
+        "publicUrl": "127.0.0.1:9000",
+        "count": count,
+        "lease_id": f"L1-{base_key}",
+        "expires": time.time() + 30,
+    }
+
+
+def _fallback_fail(*a):
+    raise AssertionError("fallback must not be used while the lease serves")
+
+
+def test_leased_source_mints_locally(monkeypatch):
+    monkeypatch.setenv("SWEED_FID_LEASE", "1")
+    calls = []
+
+    def grant(collection, replication, ttl, count):
+        calls.append(count)
+        return _grant_ok(collection, replication, ttl, count)
+
+    src = LeasedFidSource(grant, _fallback_fail)
+    fids = [src.assign("", "", "").fid for _ in range(10)]
+    assert len(set(fids)) == 10, "minted fids must be unique"
+    assert len(calls) == 1, "one lease serves many assigns"
+    st = src.stats()
+    assert st["minted"] == 10 and st["leases"] == 1
+
+
+def test_leased_source_releases_when_range_dry(monkeypatch):
+    monkeypatch.setenv("SWEED_FID_LEASE", "1")
+    monkeypatch.setenv("SWEED_FID_LEASE_COUNT", "4")
+    calls = []
+
+    def grant(collection, replication, ttl, count):
+        calls.append(count)
+        # distinct base per grant so ranges don't overlap
+        return _grant_ok(collection, replication, ttl, count,
+                         base_key=100 + 10 * len(calls))
+
+    src = LeasedFidSource(grant, _fallback_fail)
+    fids = [src.assign("", "", "").fid for _ in range(9)]
+    assert len(set(fids)) == 9
+    assert len(calls) == 3  # 4 + 4 + 1 minted across three grants
+
+
+def test_leased_source_falls_back_on_grant_failure(monkeypatch):
+    monkeypatch.setenv("SWEED_FID_LEASE", "1")
+
+    def grant(*a):
+        raise ConnectionError("master down")
+
+    sentinel = object()
+    src = LeasedFidSource(grant, lambda *a: sentinel)
+    assert src.assign("", "", "") is sentinel
+    assert src.stats()["fallbacks"] == 1
+
+
+def test_leased_source_disabled_env(monkeypatch):
+    monkeypatch.setenv("SWEED_FID_LEASE", "0")
+    sentinel = object()
+    src = LeasedFidSource(_grant_ok, lambda *a: sentinel)
+    assert src.assign("", "", "") is sentinel
+
+
+def test_leased_source_refuses_auth_without_signing_key(monkeypatch):
+    """Auth-enforced cluster, no local signing key: minted fids beyond
+    the base would be unusable — the lease path must bow out."""
+    monkeypatch.setenv("SWEED_FID_LEASE", "1")
+
+    def grant(collection, replication, ttl, count):
+        g = _grant_ok(collection, replication, ttl, count)
+        g["auth"] = "jwt-token"
+        return g
+
+    sentinel = object()
+    src = LeasedFidSource(grant, lambda *a: sentinel, sign_fn=None)
+    assert src.assign("", "", "") is sentinel
+
+
+def test_env_knobs():
+    assert lease_seconds() > 0
+    assert lease_count() >= 1
+
+
+def test_env_knob_garbage(monkeypatch):
+    monkeypatch.setenv("SWEED_FID_LEASE_S", "junk")
+    assert lease_seconds() == 30.0
+    monkeypatch.setenv("SWEED_FID_LEASE_COUNT", "-3")
+    assert lease_count() == 128

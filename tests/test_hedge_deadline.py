@@ -1,0 +1,313 @@
+"""Tail-at-scale units: hedged reads (util/hedge.py) and cross-daemon
+deadline propagation (util/deadline.py), both serving cores."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.util import deadline, hedge
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats(monkeypatch):
+    hedge.STATS.reset()
+    monkeypatch.delenv("SWEED_HEDGE", raising=False)
+    monkeypatch.delenv("SWEED_HEDGE_BUDGET", raising=False)
+    monkeypatch.delenv("SWEED_HEDGE_DELAY_MS", raising=False)
+    yield
+    hedge.STATS.reset()
+
+
+# -- delay selection ----------------------------------------------------------
+
+def test_pick_delay_env_override_wins(monkeypatch):
+    monkeypatch.setenv("SWEED_HEDGE_DELAY_MS", "7")
+    assert hedge.pick_delay_s(1.0) == pytest.approx(0.007)
+
+
+def test_pick_delay_uses_live_p99():
+    assert hedge.pick_delay_s(0.120) == pytest.approx(0.120)
+
+
+def test_pick_delay_floors_fast_p99():
+    # microsecond-fast caches must not hedge everything
+    assert hedge.pick_delay_s(0.00001) == pytest.approx(0.002)
+
+
+def test_pick_delay_default_without_evidence():
+    assert hedge.pick_delay_s(None) == pytest.approx(0.05)
+    assert hedge.pick_delay_s(0.0) == pytest.approx(0.05)
+
+
+# -- threaded hedged_call -----------------------------------------------------
+
+def test_fast_primary_never_fires_hedge():
+    fired = threading.Event()
+
+    def primary():
+        return b"data"
+
+    def hedge_leg():
+        fired.set()
+        return b"hedge"
+
+    val, winner = hedge.hedged_call(primary, hedge_leg, delay_s=0.2)
+    assert (val, winner) == (b"data", "primary")
+    assert not fired.is_set()
+    assert hedge.STATS.snapshot()["fired"] == 0
+
+
+def test_slow_primary_hedge_wins():
+    release = threading.Event()
+
+    def primary():
+        release.wait(5)
+        return b"slow"
+
+    val, winner = hedge.hedged_call(
+        primary, lambda: b"fast-replica", delay_s=0.02)
+    release.set()
+    assert (val, winner) == (b"fast-replica", "hedge")
+    snap = hedge.STATS.snapshot()
+    assert snap["fired"] == 1 and snap["wins_hedge"] == 1
+    # the abandoned primary leg counts as a cancel
+    assert snap["cancelled"] == 1
+
+
+def test_failed_primary_fails_over_without_budget(monkeypatch):
+    """A failed primary is plain failover — it must work even with a
+    zero hedge budget."""
+    monkeypatch.setenv("SWEED_HEDGE_BUDGET", "0")
+
+    def primary():
+        raise ConnectionError("replica down")
+
+    val, winner = hedge.hedged_call(primary, lambda: b"ok", delay_s=5.0)
+    assert (val, winner) == (b"ok", "hedge")
+
+
+def test_both_legs_fail_raises_primary_error():
+    def primary():
+        raise ConnectionError("primary boom")
+
+    def hedge_leg():
+        raise ConnectionError("hedge boom")
+
+    with pytest.raises(ConnectionError, match="primary boom"):
+        hedge.hedged_call(primary, hedge_leg, delay_s=0.01)
+
+
+def test_no_hedge_leg_degrades_to_plain_call():
+    val, winner = hedge.hedged_call(lambda: 41, None, delay_s=0.01)
+    assert (val, winner) == (41, "primary")
+    assert hedge.STATS.snapshot()["tracked"] == 0  # zero threads spent
+
+
+def test_disabled_via_env(monkeypatch):
+    monkeypatch.setenv("SWEED_HEDGE", "0")
+    val, winner = hedge.hedged_call(lambda: 1, lambda: 2, delay_s=0.0)
+    assert (val, winner) == (1, "primary")
+    assert hedge.STATS.snapshot()["tracked"] == 0
+
+
+def test_budget_gate_suppresses_excess_hedges(monkeypatch):
+    """Hedges are capped at max(4, tracked*ratio): a systemic slowdown
+    degrades to serial failover instead of doubling cluster load."""
+    monkeypatch.setenv("SWEED_HEDGE_BUDGET", "0.05")
+
+    def slow():
+        time.sleep(0.03)
+        return b"p"
+
+    for _ in range(8):
+        hedge.hedged_call(slow, lambda: b"h", delay_s=0.001)
+    snap = hedge.STATS.snapshot()
+    assert snap["fired"] == 4  # the grace floor
+    assert snap["skipped_budget"] == 4
+    assert snap["tracked"] == 8
+
+
+def test_budget_ratio_parsing(monkeypatch):
+    monkeypatch.setenv("SWEED_HEDGE_BUDGET", "0.5")
+    assert hedge.budget_ratio() == 0.5
+    monkeypatch.setenv("SWEED_HEDGE_BUDGET", "nan")
+    assert hedge.budget_ratio() == 0.05
+    monkeypatch.setenv("SWEED_HEDGE_BUDGET", "7")
+    assert hedge.budget_ratio() == 1.0
+    monkeypatch.setenv("SWEED_HEDGE_BUDGET", "junk")
+    assert hedge.budget_ratio() == 0.05
+
+
+# -- native ahedged_call ------------------------------------------------------
+
+def test_ahedged_fast_primary():
+    async def main():
+        async def primary():
+            return b"data"
+
+        async def hedge_leg():
+            return b"h"
+
+        return await hedge.ahedged_call(primary, hedge_leg, 0.2)
+
+    val, winner = asyncio.run(main())
+    assert (val, winner) == (b"data", "primary")
+    assert hedge.STATS.snapshot()["fired"] == 0
+
+
+def test_ahedged_slow_primary_loser_truly_cancelled():
+    cancelled = asyncio.Event()
+
+    async def main():
+        async def primary():
+            try:
+                await asyncio.sleep(5)
+                return b"slow"
+            except asyncio.CancelledError:
+                cancelled.set()
+                raise
+
+        async def hedge_leg():
+            return b"replica"
+
+        res = await hedge.ahedged_call(primary, hedge_leg, 0.02)
+        await asyncio.sleep(0)  # let the cancellation land
+        return res
+
+    val, winner = asyncio.run(main())
+    assert (val, winner) == (b"replica", "hedge")
+    assert cancelled.is_set()
+    assert hedge.STATS.snapshot()["cancelled"] == 1
+
+
+def test_ahedged_failed_primary_fails_over():
+    async def main():
+        async def primary():
+            raise ConnectionError("down")
+
+        async def hedge_leg():
+            return b"ok"
+
+        return await hedge.ahedged_call(primary, hedge_leg, 5.0)
+
+    val, winner = asyncio.run(main())
+    assert (val, winner) == (b"ok", "hedge")
+
+
+def test_ahedged_both_fail_raises_primary_error():
+    async def main():
+        async def primary():
+            raise ConnectionError("primary boom")
+
+        async def hedge_leg():
+            raise ConnectionError("hedge boom")
+
+        return await hedge.ahedged_call(primary, hedge_leg, 0.01)
+
+    with pytest.raises(ConnectionError, match="primary boom"):
+        asyncio.run(main())
+
+
+# -- deadline primitives ------------------------------------------------------
+
+def test_scope_sets_and_restores():
+    assert deadline.current() is None
+    d = deadline.after(5)
+    with deadline.scope(d):
+        assert deadline.current() == d
+        r = deadline.remaining()
+        assert r is not None and 4 < r <= 5
+        with deadline.scope(None):  # None nests transparently
+            assert deadline.current() == d
+    assert deadline.current() is None
+
+
+def test_clamp_timeout_passthrough_without_deadline():
+    assert deadline.clamp_timeout(30.0) == 30.0
+
+
+def test_clamp_timeout_shortens_to_budget():
+    with deadline.scope(deadline.after(1.0)):
+        t = deadline.clamp_timeout(30.0)
+        assert t <= 1.0
+        assert t >= deadline.MIN_TIMEOUT
+    assert deadline.counts().get("clamped", 0) >= 1
+
+
+def test_clamp_timeout_refuses_spent_budget():
+    with deadline.scope(time.time() - 1.0):
+        assert deadline.expired()
+        with pytest.raises(deadline.DeadlineExceeded):
+            deadline.clamp_timeout(30.0)
+    assert deadline.counts().get("refused_dial", 0) >= 1
+
+
+def test_header_round_trip():
+    d = deadline.after(10)
+    with deadline.scope(d):
+        v = deadline.inject_header()
+    assert v is not None
+    assert deadline.parse_header(v) == pytest.approx(d, abs=1e-5)
+
+
+def test_parse_header_rejects_garbage():
+    for bad in (None, "", "soon", "nan", "inf", "-5", "1e20", "42"):
+        assert deadline.parse_header(bad) is None
+
+
+def test_inject_header_absent_without_deadline():
+    assert deadline.inject_header() is None
+
+
+# -- deadline across a live daemon (both serving cores) -----------------------
+
+@pytest.fixture(scope="module")
+def tiny_master():
+    import socket
+
+    from seaweedfs_tpu.server.master_server import MasterServer
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    m = MasterServer(port=port, node_timeout=60).start()
+    yield m
+    m.stop()
+
+
+def test_expired_inbound_deadline_answers_504(tiny_master):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(f"http://{tiny_master.url}/dir/status")
+    req.add_header(deadline.DEADLINE_HEADER, f"{time.time() - 2:.6f}")
+    # sweedlint: ok deadline-not-propagated test drives the raw wire surface on purpose
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+    assert ei.value.code == 504
+
+
+def test_live_deadline_passes_through(tiny_master):
+    import urllib.request
+
+    req = urllib.request.Request(f"http://{tiny_master.url}/dir/status")
+    req.add_header(deadline.DEADLINE_HEADER, f"{time.time() + 30:.6f}")
+    # sweedlint: ok deadline-not-propagated test drives the raw wire surface on purpose
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
+
+
+def test_outbound_transport_injects_header():
+    """http_util's choke point must add X-Sweed-Deadline to every
+    internal call made under an active scope."""
+    from seaweedfs_tpu.server import http_util
+
+    captured = {}
+    with deadline.scope(deadline.after(30)):
+        hdrs = http_util._trace_headers({})
+        captured.update(hdrs or {})
+    assert deadline.DEADLINE_HEADER in captured

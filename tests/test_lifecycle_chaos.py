@@ -43,12 +43,7 @@ pytestmark = pytest.mark.crash
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from seaweedfs_tpu.util.netports import free_port  # noqa: E402
 
 
 # The chaos child: one persistent cluster, manual lifecycle ticks. Ports,
@@ -66,31 +61,34 @@ from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.util import faultpoints
 
-ports_file = os.path.join(statedir, "ports.json")
-if os.path.exists(ports_file):
-    with open(ports_file) as f:
-        ports = json.load(f)
-else:
-    import socket
-    def free_port():
-        s = socket.socket(); s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]; s.close(); return p
-    ports = {k: free_port() for k in ("m", "v0", "v1")}
-    with open(ports_file, "w") as f:
-        json.dump(ports, f)
+# retry-bind port plumbing (util/netports): a relaunch racing the previous
+# incarnation's sockets out of TIME_WAIT retries the SAME port with backoff
+# instead of dying on EADDRINUSE; ports.json records the final bound ports
+from seaweedfs_tpu.util import netports
 
-master = MasterServer(
-    port=ports["m"], node_timeout=60,
-    meta_dir=os.path.join(statedir, "meta"),
-).start()
+ports_file = os.path.join(statedir, "ports.json")
+ports = netports.load_or_allocate(ports_file, ["m", "v0", "v1"])
+
+master, ports["m"] = netports.start_on_port(
+    lambda p: MasterServer(
+        port=p, node_timeout=60,
+        meta_dir=os.path.join(statedir, "meta"),
+    ).start(),
+    ports["m"],
+)
 vservers = []
 for k in ("v0", "v1"):
     d = os.path.join(statedir, "vol_" + k)
     os.makedirs(d, exist_ok=True)
-    vservers.append(VolumeServer(
-        [d], port=ports[k], master_url=master.url,
-        max_volume_count=20, pulse_seconds=0.3, ec_backend="numpy",
-    ).start())
+    srv, ports[k] = netports.start_on_port(
+        lambda p: VolumeServer(
+            [d], port=p, master_url=master.url,
+            max_volume_count=20, pulse_seconds=0.3, ec_backend="numpy",
+        ).start(),
+        ports[k],
+    )
+    vservers.append(srv)
+netports.record(ports_file, ports)
 
 deadline = time.time() + 30
 while True:

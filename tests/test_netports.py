@@ -1,0 +1,100 @@
+"""util/netports units: the retry-bind helpers that kill the
+subprocess-cluster EADDRINUSE flake."""
+
+import json
+import random
+import socket
+
+import pytest
+
+from seaweedfs_tpu.util import netports
+
+
+def test_free_port_is_bindable():
+    p = netports.free_port()
+    s = socket.socket()
+    s.bind(("127.0.0.1", p))
+    s.close()
+
+
+def test_load_or_allocate_then_reload(tmp_path):
+    pf = str(tmp_path / "ports.json")
+    ports = netports.load_or_allocate(pf, ["m", "v", "f"])
+    assert set(ports) == {"m", "v", "f"}
+    assert len(set(ports.values())) == 3
+    # a "relaunched incarnation" gets the exact same map back
+    assert netports.load_or_allocate(pf, ["other", "names"]) == ports
+
+
+def test_record_overwrites_atomically(tmp_path):
+    pf = str(tmp_path / "ports.json")
+    netports.record(pf, {"m": 1111})
+    netports.record(pf, {"m": 2222, "v": 3333})
+    with open(pf) as f:
+        assert json.load(f) == {"m": 2222, "v": 3333}
+    # no torn .tmp left behind
+    assert not (tmp_path / "ports.json.tmp").exists()
+
+
+def test_start_on_port_retries_same_port_until_free():
+    port = netports.free_port()
+    state = {"tries": 0}
+
+    def factory(p):
+        state["tries"] += 1
+        if state["tries"] < 3:  # TIME_WAIT clears on the third try
+            raise OSError(98, "Address already in use")
+        return f"server@{p}"
+
+    srv, bound = netports.start_on_port(
+        factory, port, base_backoff_s=0.001, rng=random.Random(7))
+    assert (srv, bound) == (f"server@{port}", port)
+    assert state["tries"] == 3
+
+
+def test_start_on_port_matches_wrapped_bind_error():
+    # servers that wrap the bind error lose errno; the message matches
+    calls = []
+
+    def factory(p):
+        calls.append(p)
+        if len(calls) == 1:
+            raise OSError("listener died: Address already in use (bind)")
+        return "up"
+
+    srv, _ = netports.start_on_port(
+        factory, 12345, base_backoff_s=0.001, rng=random.Random(1))
+    assert srv == "up" and len(calls) == 2
+
+
+def test_start_on_port_raises_when_squatted_and_no_fallback():
+    def factory(p):
+        raise OSError(98, "Address already in use")
+
+    with pytest.raises(OSError):
+        netports.start_on_port(
+            factory, 12345, attempts=2, base_backoff_s=0.001,
+            rng=random.Random(2))
+
+
+def test_start_on_port_falls_back_to_fresh_port():
+    squatted = 12345
+
+    def factory(p):
+        if p == squatted:
+            raise OSError(98, "Address already in use")
+        return f"server@{p}"
+
+    srv, bound = netports.start_on_port(
+        factory, squatted, attempts=2, base_backoff_s=0.001,
+        fallback=True, rng=random.Random(3))
+    assert bound != squatted and srv == f"server@{bound}"
+
+
+def test_start_on_port_propagates_unrelated_errors():
+    def factory(p):
+        raise OSError(13, "Permission denied")
+
+    with pytest.raises(OSError) as ei:
+        netports.start_on_port(factory, 12345)
+    assert ei.value.errno == 13

@@ -45,18 +45,19 @@ def test_cites_are_nontrivial():
 
 
 def test_component_numbering_is_dense():
-    """Rows are numbered 1..80 (the judge's 68 components plus the
+    """Rows are numbered 1..82 (the judge's 68 components plus the
     crash-safety subsystem, the sweedlint analyzer, the pipelined data
     plane, the S3 Select query pushdown, the async serving core, the
     hot-shard path, the fleet EC data plane, the active-active
     replication layer, the tracing/histogram observability plane, the
-    lifecycle autopilot, and the native-async QoS serving path added
+    lifecycle autopilot, the native-async QoS serving path, the
+    cross-domain race detector, and the sharded filer fleet added
     later); a deleted row must be noticed, not papered over."""
     nums = [
         int(m) for m in re.findall(r"^\|\s*(\d+)\s*\|", _doc(), re.MULTILINE)
     ]
-    assert nums == list(range(1, 81)), (
-        f"component rows not dense 1..80: got {len(nums)} rows, "
+    assert nums == list(range(1, 83)), (
+        f"component rows not dense 1..82: got {len(nums)} rows, "
         f"first gap near {next((i + 1 for i, n in enumerate(nums) if n != i + 1), None)}"
     )
 

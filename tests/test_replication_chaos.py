@@ -49,12 +49,7 @@ pytestmark = pytest.mark.crash
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from seaweedfs_tpu.util.netports import free_port  # noqa: E402
 
 
 def tree_hash(filer_url, root):
@@ -92,35 +87,41 @@ from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.util import faultpoints
 
+# retry-bind port plumbing (util/netports): a relaunch racing the previous
+# incarnation's sockets out of TIME_WAIT retries the SAME port with backoff
+# instead of dying on EADDRINUSE; ports.json records the final bound ports
+from seaweedfs_tpu.util import netports
+
 ports_file = os.path.join(statedir, "ports.json")
-if os.path.exists(ports_file):
-    with open(ports_file) as f:
-        ports = json.load(f)
-else:
-    import socket
-    def free_port():
-        s = socket.socket(); s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]; s.close(); return p
-    ports = {k: free_port() for k in ("ma", "va", "fa", "mb", "vb", "fb")}
-    with open(ports_file, "w") as f:
-        json.dump(ports, f)
+ports = netports.load_or_allocate(
+    ports_file, ["ma", "va", "fa", "mb", "vb", "fb"])
 
 
 def mk_cluster(name):
     vdir = os.path.join(statedir, "vol_" + name)
     os.makedirs(vdir, exist_ok=True)
-    master = MasterServer(
-        port=ports["m" + name], node_timeout=60,
-        meta_dir=os.path.join(statedir, "meta_" + name),
-    ).start()
-    volume = VolumeServer(
-        [vdir], port=ports["v" + name], master_url=master.url,
-        max_volume_count=20, pulse_seconds=0.3,
-    ).start()
-    filer = FilerServer(
-        port=ports["f" + name], master_url=master.url, chunk_size=64 * 1024,
-        db_path=os.path.join(statedir, "filer_" + name + ".db"),
-    ).start()
+    master, ports["m" + name] = netports.start_on_port(
+        lambda p: MasterServer(
+            port=p, node_timeout=60,
+            meta_dir=os.path.join(statedir, "meta_" + name),
+        ).start(),
+        ports["m" + name],
+    )
+    volume, ports["v" + name] = netports.start_on_port(
+        lambda p: VolumeServer(
+            [vdir], port=p, master_url=master.url,
+            max_volume_count=20, pulse_seconds=0.3,
+        ).start(),
+        ports["v" + name],
+    )
+    filer, ports["f" + name] = netports.start_on_port(
+        lambda p: FilerServer(
+            port=p, master_url=master.url, chunk_size=64 * 1024,
+            db_path=os.path.join(statedir, "filer_" + name + ".db"),
+        ).start(),
+        ports["f" + name],
+    )
+    netports.record(ports_file, ports)
     return master, volume, filer
 
 
@@ -220,28 +221,28 @@ from seaweedfs_tpu.server.master_server import MasterServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.util import faultpoints
 
+# netports: same-port retry-bind on relaunch; ports.json = final ports
+from seaweedfs_tpu.util import netports
+
 ports_file = os.path.join(statedir, "ports.json")
-if os.path.exists(ports_file):
-    with open(ports_file) as f:
-        ports = json.load(f)
-else:
-    import socket
-    def free_port():
-        s = socket.socket(); s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]; s.close(); return p
-    ports = {k: free_port() for k in ("ma", "va", "fa")}
-    with open(ports_file, "w") as f:
-        json.dump(ports, f)
+ports = netports.load_or_allocate(ports_file, ["ma", "va", "fa"])
 
 vdir = os.path.join(statedir, "vol_a")
 os.makedirs(vdir, exist_ok=True)
-master = MasterServer(port=ports["ma"], node_timeout=60,
-                      meta_dir=os.path.join(statedir, "meta_a")).start()
-volume = VolumeServer([vdir], port=ports["va"], master_url=master.url,
-                      max_volume_count=20, pulse_seconds=0.3).start()
-filer = FilerServer(port=ports["fa"], master_url=master.url,
-                    chunk_size=64 * 1024,
-                    db_path=os.path.join(statedir, "filer_a.db")).start()
+master, ports["ma"] = netports.start_on_port(
+    lambda p: MasterServer(port=p, node_timeout=60,
+                           meta_dir=os.path.join(statedir, "meta_a")).start(),
+    ports["ma"])
+volume, ports["va"] = netports.start_on_port(
+    lambda p: VolumeServer([vdir], port=p, master_url=master.url,
+                           max_volume_count=20, pulse_seconds=0.3).start(),
+    ports["va"])
+filer, ports["fa"] = netports.start_on_port(
+    lambda p: FilerServer(port=p, master_url=master.url,
+                          chunk_size=64 * 1024,
+                          db_path=os.path.join(statedir, "filer_a.db")).start(),
+    ports["fa"])
+netports.record(ports_file, ports)
 
 deadline = time.time() + 20
 while True:

@@ -1,0 +1,169 @@
+"""Reshard chaos matrix: kill the reshard at every protocol window,
+bounce a filer, re-drive, and the tree must converge — zero dupes, zero
+drops, proven by content hash.
+
+The Resharder drives on a filer (POST /_reshard), so a filer killed
+mid-reshard kills the driver at whatever step it was in. Each window
+here arms an io-error faultpoint at one protocol step (apply, durable
+checkpoint, done marker, purge), aborts the run there, optionally
+hard-bounces the TARGET filer (new server process-state over the same
+sqlite store — everything non-durable is lost), then re-drives from the
+top. Idempotence markers + the durable-prefix checkpoint are what make
+the re-drive a convergence instead of a duplication."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.client import FilerClient
+from seaweedfs_tpu.filer.reshard import Resharder, tree_hash
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.master_server import MasterServer
+from seaweedfs_tpu.util import faultpoints
+from seaweedfs_tpu.util.netports import free_port, start_on_port
+
+pytestmark = pytest.mark.crash
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    """Source and target filers over persistent sqlite stores (so a
+    bounced filer resumes from durable state), one shared master."""
+    tmp = tmp_path_factory.mktemp("reshardchaos")
+    master = MasterServer(port=free_port(), node_timeout=60).start()
+    state = {
+        "tmp": tmp,
+        "master": master,
+        "filers": {},
+    }
+
+    def boot(name):
+        port = state["filers"][name].port if name in state["filers"] else free_port()
+        srv, bound = start_on_port(
+            lambda p: FilerServer(
+                port=p, master_url=master.url,
+                db_path=str(tmp / f"{name}.db"),
+            ).start(),
+            port,
+        )
+        state["filers"][name] = srv
+        return srv
+
+    boot("src")
+    boot("dst")
+    state["boot"] = boot
+    time.sleep(0.3)
+    yield state
+    for f in state["filers"].values():
+        f.stop()
+    master.stop()
+
+
+def _seed_tree(filer_url: str, root: str, files: int = 24) -> str:
+    """Metadata-only subtree (no volume plane needed): nested dirs with
+    empty-chunk file entries. Returns its content hash."""
+    c = FilerClient(filer_url)
+    now = int(time.time())
+    for i in range(files):
+        path = f"{root}/d{i % 4}/f{i:03d}.txt"
+        c.create_entry(path, {
+            "full_path": path, "is_directory": False,
+            "mtime": now, "chunks": [],
+        })
+    return tree_hash(filer_url, root)
+
+
+def _count_tree(filer_url: str, root: str) -> int:
+    c = FilerClient(filer_url)
+    n, stack = 0, [root]
+    while stack:
+        d = stack.pop()
+        for e in c.list(d):
+            n += 1
+            if e.get("is_directory"):
+                stack.append(f"{d.rstrip('/')}/{e['name']}")
+    return n
+
+
+WINDOWS = [
+    # (faultpoint, skip_hits, bounce_target)
+    ("reshard.apply", 3, False),
+    ("reshard.apply", 12, True),       # mid-copy + target filer killed
+    ("reshard.checkpoint", 1, True),   # right after a durable checkpoint
+    ("reshard.done", 0, False),        # copy done, purge never ran
+    ("reshard.purge", 0, True),        # purged, marker GC never ran
+]
+
+
+@pytest.mark.parametrize(
+    "point,skip,bounce", WINDOWS,
+    ids=[f"{p}@{s}{'+bounce' if b else ''}" for p, s, b in WINDOWS])
+def test_killed_reshard_converges(pair, point, skip, bounce):
+    src, dst = pair["filers"]["src"], pair["filers"]["dst"]
+    root = f"/chaos-{point.split('.')[1]}-{skip}"
+    before = _seed_tree(src.url, root)
+    n_before = _count_tree(src.url, root)
+    epoch = f"e-{point}-{skip}"
+
+    faultpoints.arm(point, "io-error", skip=skip, count=1)
+    try:
+        with pytest.raises(OSError):
+            Resharder(src.url, dst.url, root, epoch, ckpt_every=4).run()
+    finally:
+        faultpoints.disarm(point)
+    assert faultpoints.hits(point) >= 1  # the kill actually triggered
+
+    if bounce:
+        # kill the target filer: new process-state over the same store
+        pair["filers"]["dst"].stop()
+        dst = pair["boot"]("dst")
+        time.sleep(0.2)
+
+    # re-drive from the top — markers + checkpoint make this idempotent
+    summary = Resharder(src.url, dst.url, root, epoch, ckpt_every=4).run()
+    assert tree_hash(dst.url, root) == before, summary
+    assert _count_tree(dst.url, root) == n_before, "dupes or drops"
+    # source side is purged (metadata only)
+    assert FilerClient(src.url).get_entry(root) is None
+    # markers and checkpoint are GC'd — the KV holds no reshard residue
+    c = FilerClient(dst.url)
+    import hashlib
+
+    sha = hashlib.sha1(root.encode()).hexdigest()
+    assert c.kv_get(f"reshard.done.{epoch}.{sha}") is None
+    assert c.kv_get(f"reshard.ckpt.{epoch}.{sha}") is None
+
+
+def test_double_kill_same_epoch_converges(pair):
+    """Two successive kills in DIFFERENT windows of the same move, then a
+    clean run: still exactly one copy of everything."""
+    src, dst = pair["filers"]["src"], pair["filers"]["dst"]
+    root = "/chaos-double"
+    before = _seed_tree(src.url, root, files=30)
+    n_before = _count_tree(src.url, root)
+
+    for point, skip in (("reshard.apply", 5), ("reshard.apply", 18)):
+        faultpoints.arm(point, "io-error", skip=skip, count=1)
+        try:
+            with pytest.raises(OSError):
+                Resharder(src.url, dst.url, root, "dbl", ckpt_every=4).run()
+        finally:
+            faultpoints.disarm(point)
+
+    summary = Resharder(src.url, dst.url, root, "dbl", ckpt_every=4).run()
+    assert tree_hash(dst.url, root) == before, summary
+    assert _count_tree(dst.url, root) == n_before
+    # the third drive resumed: the bulk of the entries were already
+    # applied and skipped via checkpoint or marker, not re-copied
+    assert summary["ckpt_skips"] + summary["marker_skips"] > 0
+
+
+def test_clean_reshard_baseline(pair):
+    """Control: an unkilled reshard moves the tree and reports no skips
+    on the first (only) drive."""
+    src, dst = pair["filers"]["src"], pair["filers"]["dst"]
+    root = "/chaos-clean"
+    before = _seed_tree(src.url, root, files=10)
+    summary = Resharder(src.url, dst.url, root, "clean").run()
+    assert summary["applied"] >= 10 and summary["resumed_from"] == ""
+    assert tree_hash(dst.url, root) == before

@@ -54,6 +54,9 @@ CASES = [
     ("lock-held-across-await", "lock_held_across_await",
      "server/fixture.py"),
     ("loop-affine-escape", "loop_affine_escape", "server/fixture.py"),
+    # PR 20 sharded fleet: cross-daemon hops must carry X-Sweed-Deadline
+    ("deadline-not-propagated", "deadline_not_propagated",
+     "server/fixture.py"),
 ]
 
 
